@@ -1,0 +1,36 @@
+"""dag_rider_tpu — a TPU-native DAG-Rider (Byzantine Atomic Broadcast) framework.
+
+A from-scratch JAX/XLA/Pallas implementation of the DAG-Rider protocol
+(Keidar et al. 2021, "All You Need is DAG", arXiv:2102.08325) with the
+capabilities of the reference Go prototype (xenowits/dag-rider), re-designed
+TPU-first:
+
+- Dense tensor DAG encoding (``exists[R, n]``, ``strong[R, n, n]``) replaces
+  pointer-chasing + linear scans (reference ``process/process.go:374-384``).
+- Reachability = boolean matmul chains on the MXU (reference ``path`` BFS,
+  ``process/process.go:89-148``).
+- A batched ``Verifier`` seam (sibling of the ``Transport`` plugin boundary,
+  reference ``process/transport.go:6-9``): whole-round Ed25519 / BLS batch
+  verification as vmapped JAX + Pallas kernels, one DAG round per dispatch.
+- Host-side consensus state machine implementing the *paper* semantics
+  (the reference's quoted pseudocode), not the reference's bugs (SURVEY.md §8).
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``core``       — vertex/block data model, generic stack           (L0)
+- ``transport``  — pluggable broadcast: in-memory broker, faults    (L1)
+- ``consensus``  — DAG construction + wave commit (Alg. 1-3)        (L2/L3)
+- ``crypto``     — host reference crypto: Ed25519, BLS12-381, coin  (L4)
+- ``ops``        — JAX device kernels: graph reachability, field
+                   arithmetic, Edwards curve, SHA-512               (TPU)
+- ``verifier``   — the batched Verifier seam: CPU + TPU impls       (north star)
+- ``parallel``   — mesh/sharding helpers for multi-chip MSM         (ICI/DCN)
+- ``utils``      — metrics, checkpoint/resume, profiling
+"""
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.core.types import Block, Vertex, VertexID
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "Block", "Vertex", "VertexID", "__version__"]

@@ -1,0 +1,100 @@
+"""Framework configuration.
+
+The reference has no config system (SURVEY.md §5): its only knobs are the
+``New(index, faulty, tp)`` arguments (``process/process.go:34``) and hardcoded
+constants (wave length 4 at ``process.go:238,332,400``, channel buffer 10 at
+``process.go:174``). This dataclass makes every knob explicit, including the
+TPU-specific ones (verifier backend, device mesh shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """All tunables for one DAG-Rider deployment.
+
+    Attributes:
+        n: committee size (number of processes). Process indices are
+           0-based ints in [0, n) — unlike the reference's 1-based indices
+           (``process/process.go:38-40``), which only exist there to paper
+           over the genesis-seeding bug (SURVEY.md D2).
+        f: max Byzantine faults tolerated. Defaults to floor((n-1)/3),
+           the optimal resilience the protocol is designed for. Quorum
+           size is 2f+1 (``process.go:165,236,337``).
+        wave_length: rounds per wave. The paper (and reference) fix this
+           at 4 (``process.go:394-402``); kept configurable for experiments
+           but all tests use 4.
+        signature_scheme: "none" | "ed25519" | "bls12381". "none" matches
+           the reference (no crypto at all — SURVEY.md D10); "ed25519" is
+           the per-vertex signing scheme of the north-star Verifier.
+        verifier_backend: "cpu" | "tpu". Both must produce byte-identical
+           commit order (BASELINE.json north star).
+        coin: "fixed" | "round_robin" | "threshold_bls". "fixed" reproduces
+           the reference stub's *determinism* (``process.go:390-392``)
+           without its bug (we return wave-independent leader 0 only when
+           explicitly configured); "threshold_bls" is the real common coin
+           the reference's TODO names (``process.go:388``).
+        propose_empty: if True, a process with no queued client blocks
+           proposes an empty block instead of stalling round advancement.
+           The reference busy-waits forever instead (D7, ``process.go:277``).
+        mesh_shape: device mesh for multi-chip sharding, e.g. (8,) for a
+           1-D "batch" mesh over vertices, (4, 2) for (batch, shard).
+        mesh_axis_names: names for the mesh axes.
+        max_rounds: capacity hint for dense DAG tensors (grown on demand).
+    """
+
+    n: int = 4
+    f: Optional[int] = None
+    wave_length: int = 4
+    signature_scheme: str = "none"
+    verifier_backend: str = "cpu"
+    coin: str = "round_robin"
+    propose_empty: bool = True
+    mesh_shape: Tuple[int, ...] = (1,)
+    mesh_axis_names: Tuple[str, ...] = ("batch",)
+    max_rounds: int = 64
+
+    def __post_init__(self) -> None:
+        if self.n < 1:
+            raise ValueError(f"n must be >= 1, got {self.n}")
+        if self.f is None:
+            object.__setattr__(self, "f", (self.n - 1) // 3)
+        if self.n < 3 * self.f + 1:
+            raise ValueError(
+                f"need n >= 3f+1 for BFT resilience, got n={self.n}, f={self.f}"
+            )
+        if self.wave_length < 1:
+            raise ValueError("wave_length must be >= 1")
+        if self.signature_scheme not in ("none", "ed25519", "bls12381"):
+            raise ValueError(f"unknown signature scheme {self.signature_scheme!r}")
+        if self.verifier_backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown verifier backend {self.verifier_backend!r}")
+        if self.coin not in ("fixed", "round_robin", "threshold_bls"):
+            raise ValueError(f"unknown coin {self.coin!r}")
+
+    @property
+    def quorum(self) -> int:
+        """2f+1 — the quorum threshold used everywhere the reference uses it
+        (round advance ``process.go:236``, admission ``process.go:165``,
+        commit ``process.go:337``)."""
+        return 2 * self.f + 1
+
+    def wave_round(self, wave: int, k: int) -> int:
+        """round(w, k) = wave_length*(w-1) + k, 1-indexed k in [1, wave_length].
+
+        Mirrors ``waveRound`` (reference ``process/process.go:394-402``);
+        waves are 1-indexed, round 0 is the genesis round.
+        """
+        if not 1 <= k <= self.wave_length:
+            raise ValueError(f"k must be in [1, {self.wave_length}], got {k}")
+        return self.wave_length * (wave - 1) + k
+
+    def wave_of_round(self, rnd: int) -> int:
+        """Inverse: which wave a round >= 1 belongs to."""
+        if rnd < 1:
+            raise ValueError("rounds >= 1 belong to waves; round 0 is genesis")
+        return (rnd - 1) // self.wave_length + 1

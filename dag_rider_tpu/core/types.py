@@ -1,0 +1,133 @@
+"""Vertex / block data model.
+
+TPU-native counterpart of the reference's data model
+(``process/process.go:14-31``): a vertex is identified by ``(round, source)``,
+carries a client block payload, strong edges to round-1 vertices and weak
+edges to vertices in rounds < round-1.
+
+Differences from the reference, by design:
+
+- Sources are 0-based ints in [0, n).
+- Vertices are immutable (frozen dataclasses) and carry an optional Ed25519
+  signature + threshold-coin share — the reference has no authentication at
+  all (SURVEY.md D10) and a stubbed coin (D9).
+- A canonical byte encoding (``signing_bytes``) exists so vertices can be
+  signed/verified and checkpointed; the reference has no serialization
+  (SURVEY.md §5 "checkpoint/resume: absent").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import struct
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class VertexID:
+    """Unique vertex identity: (round, source).
+
+    Mirrors ``vertexID`` (reference ``process/process.go:19-24``). A correct
+    process creates at most one vertex per round, so this pair is unique.
+    Ordered lexicographically (round first) — this ordering is the
+    deterministic tiebreak used by total-order delivery.
+    """
+
+    round: int
+    source: int
+
+    def encode(self) -> bytes:
+        return struct.pack("<II", self.round, self.source)
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    """A client payload block (reference ``process/process.go:14-17``).
+
+    The reference's block is an empty struct; ours carries real transaction
+    bytes so end-to-end delivery is observable.
+    """
+
+    transactions: Tuple[bytes, ...] = ()
+
+    def encode(self) -> bytes:
+        out = [struct.pack("<I", len(self.transactions))]
+        for tx in self.transactions:
+            out.append(struct.pack("<I", len(tx)))
+            out.append(tx)
+        return b"".join(out)
+
+    @staticmethod
+    def decode(data: bytes, offset: int = 0) -> Tuple["Block", int]:
+        (count,) = struct.unpack_from("<I", data, offset)
+        offset += 4
+        txs = []
+        for _ in range(count):
+            (ln,) = struct.unpack_from("<I", data, offset)
+            offset += 4
+            txs.append(data[offset : offset + ln])
+            offset += ln
+        return Block(tuple(txs)), offset
+
+
+@dataclasses.dataclass(frozen=True)
+class Vertex:
+    """A DAG vertex (reference ``process/process.go:26-31``).
+
+    strong_edges point to round-1 vertices (>= 2f+1 of them for a valid
+    vertex); weak_edges point to otherwise-unreachable vertices in rounds
+    < round-1, providing the fairness/inclusion guarantee (Alg. 2 lines
+    29-31, quoted at reference ``process.go:300-302``).
+    """
+
+    id: VertexID
+    block: Block = Block()
+    strong_edges: Tuple[VertexID, ...] = ()
+    weak_edges: Tuple[VertexID, ...] = ()
+    signature: Optional[bytes] = None
+    coin_share: Optional[bytes] = None
+
+    @property
+    def round(self) -> int:
+        return self.id.round
+
+    @property
+    def source(self) -> int:
+        return self.id.source
+
+    def signing_bytes(self) -> bytes:
+        """Canonical encoding of everything a source attests to.
+
+        Excludes the signature itself. Edges are sorted so the encoding is
+        independent of construction order.
+        """
+        out = [b"dagrider-vertex-v1", self.id.encode(), self.block.encode()]
+        for label, edges in ((b"S", self.strong_edges), (b"W", self.weak_edges)):
+            out.append(label)
+            out.append(struct.pack("<I", len(edges)))
+            for e in sorted(edges):
+                out.append(e.encode())
+        out.append(b"C")
+        share = self.coin_share or b""
+        out.append(struct.pack("<I", len(share)))
+        out.append(share)
+        return b"".join(out)
+
+    def digest(self) -> bytes:
+        """SHA-512 digest of the canonical encoding (what gets signed)."""
+        return hashlib.sha512(self.signing_bytes()).digest()
+
+
+@dataclasses.dataclass(frozen=True)
+class BroadcastMessage:
+    """The unit the Transport carries (reference ``bcastMsg``,
+    ``process/transport.go:11-18``): a vertex plus the round/sender stamps.
+
+    The reference *trusts* these stamps (D10, ``process.go:159-162``); here
+    they are cross-checked against the signed vertex id on receipt.
+    """
+
+    vertex: Vertex
+    round: int
+    sender: int

@@ -1,0 +1,23 @@
+from dag_rider_tpu.ops.dag_kernels import (
+    admission_mask,
+    closure_from,
+    closure_from_full,
+    leader_reach,
+    pairwise_reach,
+    reach_chain,
+    round_complete,
+    strong_edge_quorum,
+    wave_commit_votes,
+)
+
+__all__ = [
+    "admission_mask",
+    "closure_from",
+    "closure_from_full",
+    "leader_reach",
+    "pairwise_reach",
+    "reach_chain",
+    "round_complete",
+    "strong_edge_quorum",
+    "wave_commit_votes",
+]

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so multi-chip sharding (Mesh/pjit/shard_map) is exercised in every
+test run without TPU hardware. The driver separately dry-runs the multi-chip
+path via ``__graft_entry__.dryrun_multichip``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,88 @@
+"""The Figure-1 golden DAG fixture.
+
+Faithful port of the reference's ``createDag`` fixture
+(``process/process_internal_test.go:86-283``), which reproduces Figure 1,
+page 4 of the DAG-Rider paper: 4 processes, rounds 0-4, explicit strong
+edges, one weak edge (4, p1) -> (2, p4).
+
+Sources here are 0-based (reference is 1-based): source i here = reference
+source i+1. Rounds are identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+N = 4
+ROUNDS = 5  # rounds 0..4
+
+# strong edges per (round, source) -> set of round-(r-1) sources.
+# Reference lines: r1 edges :103-158, r2 :161-216, r3 :219-256, r4 :259-272.
+STRONG: Dict[Tuple[int, int], Tuple[int, ...]] = {
+    (1, 0): (0, 1, 2),
+    (1, 1): (0, 1, 2),
+    (1, 2): (0, 1, 2),
+    (1, 3): (0, 1, 2),
+    (2, 0): (0, 1, 3),
+    (2, 1): (0, 1, 3),
+    (2, 2): (0, 2, 3),
+    (2, 3): (0, 1, 3),
+    (3, 0): (0, 2),
+    (3, 1): (0, 1, 2),
+    (3, 2): (0, 1, 2),
+    # (3, 3): no edges — vertex exists but is disconnected in the fixture
+    (4, 0): (0, 1, 2),
+}
+
+# weak edges: (round, source) -> list of (round, source) targets.
+# Reference: process_internal_test.go:275-280, (4,1)->(2,4) 1-based.
+WEAK: Dict[Tuple[int, int], Tuple[Tuple[int, int], ...]] = {
+    (4, 0): ((2, 3),),
+}
+
+
+def figure1_tensors() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dense encoding of the fixture.
+
+    Returns:
+        exists: bool[ROUNDS, N] — all vertices present (the reference fixture
+            materializes every (r, p) vertex, including edge-less ones).
+        strong: bool[ROUNDS, N, N] — strong[r, i, j]: (r,i) -> (r-1,j).
+        weak:   bool[ROUNDS, N, ROUNDS, N] — dense weak-edge form.
+    """
+    exists = np.ones((ROUNDS, N), dtype=bool)
+    strong = np.zeros((ROUNDS, N, N), dtype=bool)
+    for (r, i), targets in STRONG.items():
+        for j in targets:
+            strong[r, i, j] = True
+    weak = np.zeros((ROUNDS, N, ROUNDS, N), dtype=bool)
+    for (r, i), targets in WEAK.items():
+        for r2, j in targets:
+            weak[r, i, r2, j] = True
+    return exists, strong, weak
+
+
+def figure1_vertices() -> List:
+    """The fixture as a list of Vertex objects (for host-state tests)."""
+    from dag_rider_tpu.core.types import Block, Vertex, VertexID
+
+    out = []
+    for r in range(ROUNDS):
+        for i in range(N):
+            strong_edges = tuple(
+                VertexID(r - 1, j) for j in STRONG.get((r, i), ())
+            )
+            weak_edges = tuple(
+                VertexID(r2, j) for (r2, j) in WEAK.get((r, i), ())
+            )
+            out.append(
+                Vertex(
+                    id=VertexID(r, i),
+                    block=Block((f"tx-{r}-{i}".encode(),)),
+                    strong_edges=strong_edges,
+                    weak_edges=weak_edges,
+                )
+            )
+    return out

@@ -1,0 +1,50 @@
+import pytest
+
+from dag_rider_tpu import Config
+
+
+def test_defaults():
+    cfg = Config(n=4)
+    assert cfg.f == 1
+    assert cfg.quorum == 3
+    assert cfg.wave_length == 4
+
+
+def test_f_derivation():
+    assert Config(n=1).f == 0
+    assert Config(n=4).f == 1
+    assert Config(n=7).f == 2
+    assert Config(n=256).f == 85
+
+
+def test_resilience_bound_enforced():
+    with pytest.raises(ValueError):
+        Config(n=4, f=2)  # 3f+1 = 7 > 4
+
+
+def test_wave_round_arithmetic():
+    # round(w, k) = 4(w-1)+k, mirroring reference process/process.go:394-402.
+    cfg = Config(n=4)
+    assert cfg.wave_round(1, 1) == 1
+    assert cfg.wave_round(1, 4) == 4
+    assert cfg.wave_round(2, 1) == 5
+    assert cfg.wave_round(3, 4) == 12
+    assert cfg.wave_of_round(1) == 1
+    assert cfg.wave_of_round(4) == 1
+    assert cfg.wave_of_round(5) == 2
+    assert cfg.wave_of_round(12) == 3
+    with pytest.raises(ValueError):
+        cfg.wave_round(1, 5)
+    with pytest.raises(ValueError):
+        cfg.wave_of_round(0)
+
+
+def test_invalid_knobs():
+    with pytest.raises(ValueError):
+        Config(n=0)
+    with pytest.raises(ValueError):
+        Config(n=4, signature_scheme="rsa")
+    with pytest.raises(ValueError):
+        Config(n=4, verifier_backend="gpu")
+    with pytest.raises(ValueError):
+        Config(n=4, coin="lava_lamp")

@@ -1,0 +1,44 @@
+"""Stack tests — counterpart of reference ``stack/stack_test.go`` plus the
+empty-pop guard the reference lacks (SURVEY.md D11)."""
+
+import pytest
+
+from dag_rider_tpu.core import Stack
+
+
+def test_push_pop_roundtrip():
+    s = Stack()
+    assert s.is_empty()
+    s.push(1)
+    s.push(2)
+    s.push(3)
+    assert not s.is_empty()
+    assert len(s) == 3
+    assert s.pop() == 3
+    assert s.pop() == 2
+    assert s.pop() == 1
+    assert s.is_empty()
+
+
+def test_pop_empty_raises():
+    s = Stack()
+    with pytest.raises(IndexError):
+        s.pop()
+    with pytest.raises(IndexError):
+        s.peek()
+
+
+def test_iter_is_pop_order():
+    s = Stack()
+    for i in range(5):
+        s.push(i)
+    assert list(s) == [4, 3, 2, 1, 0]
+    assert len(s) == 5  # iteration does not consume
+
+
+def test_generic_over_objects():
+    s = Stack()
+    s.push(("vertex", 1))
+    s.push(("vertex", 2))
+    assert s.peek() == ("vertex", 2)
+    assert s.pop() == ("vertex", 2)
