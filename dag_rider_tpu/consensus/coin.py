@@ -1,0 +1,76 @@
+"""Common-coin (wave leader election) implementations.
+
+The reference's ``chooseLeader`` is a stub that always returns 1
+(``process/process.go:386-392``) with a TODO naming the real design: "PKI
+and a threshold signature scheme with a threshold of (f+1)-of-n"
+(``process.go:388``). The coin must satisfy agreement, termination,
+unpredictability and fairness (``process.go:386-387``).
+
+Three implementations:
+
+- :class:`FixedCoin` — the reference stub's semantics (constant leader),
+  kept for differential testing against the reference's intent; predictable,
+  breaks liveness against an adaptive adversary (SURVEY.md D9).
+- :class:`RoundRobinCoin` — deterministic wave-indexed rotation. Fair and
+  live against *static* adversaries; still predictable. Default for tests.
+- ``ThresholdCoin`` (:mod:`dag_rider_tpu.crypto.threshold`) — the real
+  (f+1)-of-n threshold-BLS coin; shares are piggybacked on round(w,4)
+  vertices so the coin is revealed only once the wave is complete.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+
+class CommonCoin(abc.ABC):
+    """Leader-election oracle for waves.
+
+    ``observe_share`` feeds coin shares extracted from delivered vertices;
+    ``ready`` says whether wave w's coin can be evaluated; ``choose_leader``
+    returns the elected source index (must be identical at every correct
+    process — the agreement property).
+    """
+
+    @abc.abstractmethod
+    def ready(self, wave: int) -> bool: ...
+
+    @abc.abstractmethod
+    def choose_leader(self, wave: int) -> int: ...
+
+    def my_share(self, wave: int) -> Optional[bytes]:
+        """Share this process contributes for wave ``wave`` (piggybacked on
+        its round(w,4) vertex). None for share-less coins."""
+        return None
+
+    def observe_share(self, wave: int, source: int, share: bytes) -> None:
+        """Ingest another process's share. No-op for share-less coins."""
+
+
+class FixedCoin(CommonCoin):
+    """Constant leader — reference-stub semantics (``process.go:390-392``),
+    with the constant made explicit instead of hardcoded."""
+
+    def __init__(self, leader: int = 0):
+        self._leader = leader
+
+    def ready(self, wave: int) -> bool:
+        return True
+
+    def choose_leader(self, wave: int) -> int:
+        return self._leader
+
+
+class RoundRobinCoin(CommonCoin):
+    """Wave-indexed rotation: leader(w) = w mod n. Deterministic and fair
+    (every source leads infinitely often); not unpredictable."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def ready(self, wave: int) -> bool:
+        return True
+
+    def choose_leader(self, wave: int) -> int:
+        return wave % self.n

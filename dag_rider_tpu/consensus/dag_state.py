@@ -1,0 +1,171 @@
+"""Host-side DAG state with dense numpy mirrors.
+
+The authoritative per-process DAG. Replaces the reference's
+``dag [][]vertex`` array-of-rounds plus linear scans
+(``process/process.go:76, 374-384``) with:
+
+- a ``(round, source) -> Vertex`` map for payload access, and
+- dense boolean mirrors ``exists[R, n]`` / ``strong[R, n, n]`` — the exact
+  tensors the device kernels (:mod:`dag_rider_tpu.ops.dag_kernels`) consume,
+  so shipping a round/wave to the TPU is a zero-copy slice, and the dense
+  encoding doubles as the checkpoint format (SURVEY.md §5: the reference has
+  no serialization at all).
+
+Weak edges are kept sparse host-side (they are rare and round-skipping);
+ordering/reachability queries use vectorized frontier propagation over the
+dense mirrors + sparse weak lists — O(rounds * n) bitmap work per query
+instead of the reference's per-edge full-DAG scans.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.core.types import Vertex, VertexID
+
+
+class DagState:
+    """One process's view of the DAG (rounds x sources)."""
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.n = cfg.n
+        self._capacity = max(cfg.max_rounds, 8)
+        self.exists = np.zeros((self._capacity, self.n), dtype=bool)
+        self.strong = np.zeros((self._capacity, self.n, self.n), dtype=bool)
+        # weak[(r, i)] -> tuple of (r2, j) targets, r2 < r-1.
+        self.weak: Dict[Tuple[int, int], Tuple[Tuple[int, int], ...]] = {}
+        self.vertices: Dict[VertexID, Vertex] = {}
+        self.max_round = 0
+
+    # -- growth ------------------------------------------------------------
+
+    def _ensure_capacity(self, rnd: int) -> None:
+        if rnd < self._capacity:
+            return
+        new_cap = self._capacity
+        while new_cap <= rnd:
+            new_cap *= 2
+        exists = np.zeros((new_cap, self.n), dtype=bool)
+        strong = np.zeros((new_cap, self.n, self.n), dtype=bool)
+        exists[: self._capacity] = self.exists
+        strong[: self._capacity] = self.strong
+        self.exists, self.strong = exists, strong
+        self._capacity = new_cap
+
+    # -- mutation ----------------------------------------------------------
+
+    def insert(self, v: Vertex) -> None:
+        """Add a vertex whose predecessors are already present.
+
+        Admission policy (who may call this, and when) lives in the Process;
+        this container only maintains the mirrors.
+        """
+        self._ensure_capacity(v.round)
+        if self.exists[v.round, v.source]:
+            raise ValueError(f"vertex {v.id} already present")
+        self.vertices[v.id] = v
+        self.exists[v.round, v.source] = True
+        for e in v.strong_edges:
+            if e.round != v.round - 1:
+                raise ValueError(
+                    f"strong edge {e} from {v.id} must target round {v.round - 1}"
+                )
+            self.strong[v.round, v.source, e.source] = True
+        if v.weak_edges:
+            self.weak[(v.round, v.source)] = tuple(
+                (e.round, e.source) for e in v.weak_edges
+            )
+        if v.round > self.max_round:
+            self.max_round = v.round
+
+    # -- queries -----------------------------------------------------------
+
+    def present(self, vid: VertexID) -> bool:
+        """Membership — the reference's ``present`` full-DAG scan
+        (``process/process.go:373-384``), here O(1)."""
+        if vid.round >= self._capacity or vid.round < 0:
+            return False
+        return bool(self.exists[vid.round, vid.source])
+
+    def get(self, vid: VertexID) -> Optional[Vertex]:
+        return self.vertices.get(vid)
+
+    def round_size(self, rnd: int) -> int:
+        if rnd >= self._capacity:
+            return 0
+        return int(self.exists[rnd].sum())
+
+    def vertices_in_round(self, rnd: int) -> List[Vertex]:
+        if rnd >= self._capacity:
+            return []
+        return [
+            self.vertices[VertexID(rnd, i)]
+            for i in np.flatnonzero(self.exists[rnd])
+        ]
+
+    def closure(
+        self, seeds: Iterable[VertexID], strong_only: bool = False
+    ) -> np.ndarray:
+        """Causal history of a seed set as a bool[R, n] bitmap.
+
+        Vectorized frontier propagation round-by-round (the host twin of
+        :func:`dag_rider_tpu.ops.dag_kernels.closure_from`); weak edges are
+        applied from the sparse map. Replaces the reference's per-target BFS
+        ``path`` (``process/process.go:89-148``).
+        """
+        R = self.max_round + 1
+        reached = np.zeros((R, self.n), dtype=bool)
+        top = -1
+        for s in seeds:
+            if not self.present(s):
+                raise KeyError(f"seed {s} not in DAG")
+            reached[s.round, s.source] = True
+            top = max(top, s.round)
+        for r in range(top, 0, -1):
+            row = reached[r]
+            if not row.any():
+                continue
+            # strong: one vector-matrix product per round.
+            reached[r - 1] |= row @ self.strong[r]
+            if not strong_only:
+                for i in np.flatnonzero(row):
+                    for (r2, j) in self.weak.get((r, i), ()):
+                        reached[r2, j] = True
+        return reached
+
+    def path(
+        self, frm: VertexID, to: VertexID, strong_only: bool = False
+    ) -> bool:
+        """Is there a (strong-)path from ``frm`` down to ``to``?
+
+        Mirrors the reference API ``path(from, to, strongPath)``
+        (``process/process.go:89``): edges point from higher rounds to lower,
+        so a path exists iff ``to`` is in ``frm``'s causal history.
+        """
+        if not self.present(frm) or not self.present(to):
+            return False
+        if frm == to:
+            return True
+        if to.round >= frm.round:
+            return False
+        reached = self.closure([frm], strong_only=strong_only)
+        return bool(reached[to.round, to.source])
+
+    # -- dense views for device kernels ------------------------------------
+
+    def strong_stack(self, hi: int, lo: int) -> np.ndarray:
+        """strong adjacency chain for rounds (lo, hi], top round first —
+        the input format of :func:`ops.dag_kernels.reach_chain`."""
+        if not 0 <= lo < hi:
+            raise ValueError(f"need 0 <= lo < hi, got lo={lo}, hi={hi}")
+        return self.strong[hi:lo:-1]
+
+    def dense_snapshot(self, rounds: Optional[int] = None):
+        """(exists, strong) trimmed to ``rounds`` rows — checkpoint payload
+        and device-dispatch input."""
+        R = (self.max_round + 1) if rounds is None else rounds
+        return self.exists[:R].copy(), self.strong[:R].copy()

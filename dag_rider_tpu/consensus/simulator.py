@@ -1,0 +1,121 @@
+"""Deterministic N-process cluster simulation.
+
+The reference's multi-node story is "N Process instances sharing one
+in-memory Transport" but no test ever exercises it (SURVEY.md §4). This
+harness makes that story real and *deterministic*: processes are synchronous
+state machines, the broker delivers FIFO, and a seeded scheduler can
+interleave deliveries to explore asynchrony.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.coin import CommonCoin
+from dag_rider_tpu.consensus.process import Process
+from dag_rider_tpu.core.types import Block, Vertex
+from dag_rider_tpu.transport.base import Transport
+from dag_rider_tpu.transport.memory import InMemoryTransport
+
+
+class Simulation:
+    """Build-and-run helper for an n-node in-process cluster."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        *,
+        transport: Optional[Transport] = None,
+        coin_factory: Optional[Callable[[int], CommonCoin]] = None,
+        verifier_factory: Optional[Callable[[int], object]] = None,
+        signer_factory: Optional[Callable[[int], object]] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.transport = transport if transport is not None else InMemoryTransport()
+        self.deliveries: List[List[Vertex]] = [[] for _ in range(cfg.n)]
+        self.processes: List[Process] = []
+        for i in range(cfg.n):
+            sink = self.deliveries[i]
+            self.processes.append(
+                Process(
+                    cfg,
+                    i,
+                    self.transport,
+                    coin=coin_factory(i) if coin_factory else None,
+                    verifier=verifier_factory(i) if verifier_factory else None,
+                    signer=signer_factory(i) if signer_factory else None,
+                    on_deliver=sink.append,
+                )
+            )
+
+    def submit_blocks(self, per_process: int, tx_bytes: int = 32) -> None:
+        """Queue distinct client blocks at every process."""
+        for p in self.processes:
+            for k in range(per_process):
+                p.submit(
+                    Block((f"p{p.index}-blk{k}".encode().ljust(tx_bytes, b"."),))
+                )
+
+    def run(self, max_messages: int = 100_000) -> int:
+        """Start everyone, then pump to quiescence. Returns messages
+        delivered. Deterministic for a given construction order."""
+        for p in self.processes:
+            p.start()
+        delivered = 0
+        while delivered < max_messages:
+            if not self._pump_once():
+                break
+            delivered += 1
+        return delivered
+
+    def _pump_once(self) -> bool:
+        pump = getattr(self.transport, "pump_one", None)
+        if pump is None:
+            raise TypeError("transport has no pump; drive it externally")
+        return bool(pump())
+
+    # -- assertions for tests ---------------------------------------------
+
+    def delivered_ids(self, i: int) -> List:
+        return [v.id for v in self.deliveries[i]]
+
+    def check_agreement(self) -> None:
+        """Total order safety: every pair of processes delivered consistent
+        prefixes (one may lag the other). All pairs are compared — a lagging
+        p0 must not mask divergence between other processes."""
+        logs = [self.delivered_ids(i) for i in range(self.cfg.n)]
+        for i in range(self.cfg.n):
+            for j in range(i + 1, self.cfg.n):
+                a, b = logs[i], logs[j]
+                k = min(len(a), len(b))
+                if a[:k] != b[:k]:
+                    raise AssertionError(
+                        f"order divergence between p{i} and p{j}: "
+                        f"{a[:k]} vs {b[:k]}"
+                    )
+
+
+class RandomizedScheduler:
+    """Seeded adversarial-ish scheduler: delivers queued messages in random
+    order by pumping the broker after shuffling its queue. Used by
+    property tests over message interleavings (SURVEY.md §5 race-detection
+    build item)."""
+
+    def __init__(self, transport: InMemoryTransport, seed: int) -> None:
+        self.transport = transport
+        self.rng = random.Random(seed)
+
+    def run(self, max_messages: int = 100_000) -> int:
+        delivered = 0
+        while delivered < max_messages:
+            items = self.transport.drain_pending()
+            if not items:
+                break
+            self.rng.shuffle(items)
+            self.transport.requeue(items)
+            if not self.transport.pump_one():
+                break
+            delivered += 1
+        return delivered

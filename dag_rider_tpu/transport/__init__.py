@@ -1,0 +1,11 @@
+from dag_rider_tpu.transport.base import Handler, Transport
+from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
+from dag_rider_tpu.transport.memory import InMemoryTransport
+
+__all__ = [
+    "Handler",
+    "Transport",
+    "FaultPlan",
+    "FaultyTransport",
+    "InMemoryTransport",
+]

@@ -1,0 +1,42 @@
+"""Transport — the pluggable broadcast boundary.
+
+The reference's only plugin seam (``process/transport.go:6-9``): a process
+receives a Transport at construction and never touches the network
+otherwise. We keep that seam and fix its defects (SURVEY.md D12):
+
+- delivery is via registered per-process handlers, not bare channels;
+- no delivery to the sender (a process inserts its own vertex directly);
+- implementations must be race-free between ``broadcast`` and ``subscribe``.
+
+Implementations: in-memory broker with a deterministic pump
+(:mod:`dag_rider_tpu.transport.memory`), fault-injection wrapper
+(:mod:`dag_rider_tpu.transport.faults`), and a socket transport for
+multi-host deployments (:mod:`dag_rider_tpu.transport.net`).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable
+
+from dag_rider_tpu.core.types import BroadcastMessage
+
+Handler = Callable[[BroadcastMessage], None]
+
+
+class Transport(abc.ABC):
+    """Reliable point-to-all broadcast abstraction (r_bcast / r_deliver).
+
+    Like the reference (``transport.go:5``), the transport itself is the
+    "reliable" layer by fiat for in-process deployments; Byzantine-grade
+    reliable broadcast (echo/ready amplification) layers on top — see
+    :mod:`dag_rider_tpu.transport.rbc`.
+    """
+
+    @abc.abstractmethod
+    def broadcast(self, msg: BroadcastMessage) -> None:
+        """Queue ``msg`` for delivery to every subscriber except the sender."""
+
+    @abc.abstractmethod
+    def subscribe(self, index: int, handler: Handler) -> None:
+        """Register ``handler`` as process ``index``'s delivery callback."""

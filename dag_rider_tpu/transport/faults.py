@@ -1,0 +1,106 @@
+"""Fault-injection transport wrapper.
+
+The reference never injects or tolerates a single fault — ``faulty`` is only
+an arithmetic parameter (SURVEY.md §5: "required to claim BFT capability at
+all"). This wrapper layers Byzantine network behavior over any Transport:
+
+- drop: lose a message to some destination,
+- delay: hold a message back (re-queued on ``flush_delayed``),
+- duplicate: deliver twice,
+- equivocate: substitute a conflicting vertex for a chosen sender.
+
+All decisions come from a seeded RNG — runs are reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, List, Optional
+
+from dag_rider_tpu.core.types import BroadcastMessage, Vertex
+from dag_rider_tpu.transport.base import Handler, Transport
+from dag_rider_tpu.transport.memory import InMemoryTransport
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Probabilities per (message, destination) decision."""
+
+    drop: float = 0.0
+    delay: float = 0.0
+    duplicate: float = 0.0
+    # source indices considered Byzantine for equivocation injection
+    equivocators: tuple = ()
+    seed: int = 0
+
+
+class FaultyTransport(Transport):
+    """Wraps an InMemoryTransport, applying a FaultPlan on broadcast."""
+
+    def __init__(self, plan: FaultPlan, inner: Optional[InMemoryTransport] = None):
+        self.inner = inner if inner is not None else InMemoryTransport()
+        self.plan = plan
+        self.rng = random.Random(plan.seed)
+        self.delayed: List[tuple] = []
+        self.stats = {"dropped": 0, "delayed": 0, "duplicated": 0, "equivocated": 0}
+        self._mutator: Optional[Callable[[Vertex], Vertex]] = None
+
+    def set_equivocation_mutator(self, fn: Callable[[Vertex], Vertex]) -> None:
+        """How to corrupt an equivocator's vertex (defaults to payload swap)."""
+        self._mutator = fn
+
+    def subscribe(self, index: int, handler: Handler) -> None:
+        self.inner.subscribe(index, handler)
+
+    def broadcast(self, msg: BroadcastMessage) -> None:
+        dests = [d for d in self.inner.subscribers() if d != msg.sender]
+        for dest in dests:
+            out = msg
+            if msg.sender in self.plan.equivocators and self.rng.random() < 0.5:
+                out = dataclasses.replace(msg, vertex=self._equivocate(msg.vertex))
+                self.stats["equivocated"] += 1
+            roll = self.rng.random()
+            if roll < self.plan.drop:
+                self.stats["dropped"] += 1
+                continue
+            if roll < self.plan.drop + self.plan.delay:
+                self.stats["delayed"] += 1
+                self.delayed.append((dest, out))
+                continue
+            self._enqueue(dest, out)
+            if self.rng.random() < self.plan.duplicate:
+                self.stats["duplicated"] += 1
+                self._enqueue(dest, out)
+
+    def _equivocate(self, v: Vertex) -> Vertex:
+        if self._mutator is not None:
+            return self._mutator(v)
+        from dag_rider_tpu.core.types import Block
+
+        return dataclasses.replace(
+            v, block=Block((b"equivocation-" + bytes(str(v.id), "ascii"),))
+        )
+
+    def _enqueue(self, dest: int, msg: BroadcastMessage) -> None:
+        self.inner.enqueue(dest, msg)
+
+    def flush_delayed(self) -> int:
+        """Release all held-back messages into the queue (asynchrony: every
+        message is eventually delivered)."""
+        n = len(self.delayed)
+        for dest, msg in self.delayed:
+            self._enqueue(dest, msg)
+        self.delayed.clear()
+        return n
+
+    # pump passthrough so Simulation can drive us
+    def pump_one(self) -> bool:
+        return self.inner.pump_one()
+
+    def pump(self, max_messages: Optional[int] = None) -> int:
+        return self.inner.pump(max_messages)
+
+    @property
+    def pending(self) -> int:
+        return self.inner.pending

@@ -1,0 +1,97 @@
+"""In-memory broadcast broker with a deterministic pump.
+
+Counterpart of the reference's ``Transport`` broker
+(``process/transport.go:11-32``) with D12 fixed:
+
+- one lock guards both ``subscribe`` and ``broadcast`` (the reference's
+  ``Broadcast`` iterates ``subs`` lockless while ``Subscribe`` appends);
+- the sender is excluded from fan-out (a process inserts its own vertex
+  directly — the reference loops messages back to the sender);
+- delivery is decoupled from broadcast: ``broadcast`` only enqueues, and a
+  pump (:meth:`pump` / :meth:`pump_one`) drains the queue FIFO. This gives
+  deterministic, replayable schedules for tests — the reference's
+  channel-fanout schedule is whatever the Go runtime decides.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Tuple
+
+from dag_rider_tpu.core.types import BroadcastMessage
+from dag_rider_tpu.transport.base import Handler, Transport
+
+
+class InMemoryTransport(Transport):
+    """N processes in one OS process, zero networking — the simulation
+    backend for integration tests (SURVEY.md §4 "multi-node story")."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._handlers: Dict[int, Handler] = {}
+        self._queue: Deque[Tuple[int, BroadcastMessage]] = deque()
+        self.delivered_count = 0
+
+    def subscribe(self, index: int, handler: Handler) -> None:
+        with self._lock:
+            if index in self._handlers:
+                raise ValueError(f"process {index} already subscribed")
+            self._handlers[index] = handler
+
+    def broadcast(self, msg: BroadcastMessage) -> None:
+        with self._lock:
+            for dest in sorted(self._handlers):
+                if dest != msg.sender:
+                    self._queue.append((dest, msg))
+
+    # -- composition hooks (used by FaultyTransport / schedulers) ----------
+
+    def subscribers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._handlers)
+
+    def enqueue(self, dest: int, msg: BroadcastMessage) -> None:
+        """Queue a message for one destination (bypassing fan-out) — the
+        seam fault-injection wrappers compose through."""
+        with self._lock:
+            if dest not in self._handlers:
+                raise KeyError(f"no subscriber {dest}")
+            self._queue.append((dest, msg))
+
+    def drain_pending(self) -> list[Tuple[int, BroadcastMessage]]:
+        """Atomically remove and return all queued (dest, msg) pairs —
+        schedulers reorder these and requeue."""
+        with self._lock:
+            items = list(self._queue)
+            self._queue.clear()
+        return items
+
+    def requeue(self, items) -> None:
+        with self._lock:
+            self._queue.extend(items)
+
+    # -- pump --------------------------------------------------------------
+
+    def pump_one(self) -> bool:
+        """Deliver the oldest queued message. Returns False if idle."""
+        with self._lock:
+            if not self._queue:
+                return False
+            dest, msg = self._queue.popleft()
+            handler = self._handlers[dest]
+        handler(msg)  # outside the lock: handlers may broadcast
+        self.delivered_count += 1
+        return True
+
+    def pump(self, max_messages: int | None = None) -> int:
+        """Deliver until the queue drains (or ``max_messages``)."""
+        delivered = 0
+        while (max_messages is None or delivered < max_messages) and self.pump_one():
+            delivered += 1
+        return delivered
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)

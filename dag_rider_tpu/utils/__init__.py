@@ -1,0 +1,3 @@
+from dag_rider_tpu.utils.metrics import Metrics, Timer
+
+__all__ = ["Metrics", "Timer"]

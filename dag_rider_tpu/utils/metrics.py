@@ -1,0 +1,54 @@
+"""Consensus observability counters.
+
+The reference has none (SURVEY.md §5: logging only, 3 Debug call sites).
+These counters feed the BASELINE.json metric surface: rounds advanced,
+waves decided/skipped, vertices delivered, verify-batch latency.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+
+class Metrics:
+    """Per-process counters + verify-latency samples."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.verify_batch_seconds: List[float] = []
+        self.verify_batch_sizes: List[int] = []
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] += by
+
+    def observe_verify_batch(self, size: int, seconds: float) -> None:
+        self.verify_batch_sizes.append(size)
+        self.verify_batch_seconds.append(seconds)
+
+    def sigs_per_sec(self) -> float:
+        total_t = sum(self.verify_batch_seconds)
+        if total_t == 0:
+            return 0.0
+        return sum(self.verify_batch_sizes) / total_t
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self.counters)
+        if self.verify_batch_sizes:
+            out["verify_sigs_per_sec"] = self.sigs_per_sec()
+            lat = sorted(self.verify_batch_seconds)
+            out["verify_batch_p50_ms"] = 1e3 * lat[len(lat) // 2]
+        return out
+
+
+class Timer:
+    """Context manager: ``with Timer() as t: ...; t.seconds``."""
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        self.seconds = 0.0
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.seconds = time.perf_counter() - self._start

@@ -1,0 +1,99 @@
+"""DagState tests: the five reference path subtests run against the host
+mirrors (``process_internal_test.go:20-83``), plus insert/query invariants."""
+
+import numpy as np
+import pytest
+
+from dag_rider_tpu import Config
+from dag_rider_tpu.consensus import DagState
+from dag_rider_tpu.core.types import Vertex, VertexID
+
+from fixtures import figure1_vertices
+
+
+@pytest.fixture()
+def fig1_state():
+    cfg = Config(n=4, max_rounds=8)
+    st = DagState(cfg)
+    for v in figure1_vertices():
+        st.insert(v)
+    return st
+
+
+def test_path_strong_consecutive(fig1_state):
+    assert fig1_state.path(VertexID(3, 0), VertexID(2, 2), strong_only=True)
+
+
+def test_path_strong_two_rounds(fig1_state):
+    assert fig1_state.path(VertexID(3, 2), VertexID(1, 3), strong_only=True)
+
+
+def test_path_weak(fig1_state):
+    assert fig1_state.path(VertexID(4, 0), VertexID(2, 3), strong_only=False)
+    # weak edge must NOT count as a strong path
+    assert not fig1_state.path(VertexID(4, 0), VertexID(2, 3), strong_only=True)
+
+
+def test_path_hybrid(fig1_state):
+    assert fig1_state.path(VertexID(4, 0), VertexID(1, 0), strong_only=False)
+
+
+def test_path_negative(fig1_state):
+    assert not fig1_state.path(VertexID(3, 2), VertexID(2, 3), strong_only=False)
+
+
+def test_path_identity_and_direction(fig1_state):
+    v = VertexID(2, 1)
+    assert fig1_state.path(v, v)
+    # paths only go down in rounds
+    assert not fig1_state.path(VertexID(1, 0), VertexID(2, 0))
+
+
+def test_present_and_round_size(fig1_state):
+    assert fig1_state.present(VertexID(4, 0))
+    assert not fig1_state.present(VertexID(5, 0))
+    assert not fig1_state.present(VertexID(4, 9) if False else VertexID(7, 0))
+    assert fig1_state.round_size(1) == 4
+    assert fig1_state.round_size(9) == 0
+
+
+def test_insert_validation():
+    cfg = Config(n=4)
+    st = DagState(cfg)
+    st.insert(Vertex(id=VertexID(0, 0)))
+    with pytest.raises(ValueError):
+        st.insert(Vertex(id=VertexID(0, 0)))  # duplicate
+    with pytest.raises(ValueError):
+        # strong edge must target round-1
+        st.insert(
+            Vertex(id=VertexID(2, 1), strong_edges=(VertexID(0, 0),))
+        )
+
+
+def test_capacity_growth():
+    cfg = Config(n=4, max_rounds=8)
+    st = DagState(cfg)
+    for i in range(4):
+        st.insert(Vertex(id=VertexID(0, i)))
+    prev = {VertexID(0, i) for i in range(4)}
+    for r in range(1, 40):
+        for i in range(4):
+            st.insert(
+                Vertex(
+                    id=VertexID(r, i),
+                    strong_edges=tuple(VertexID(r - 1, j) for j in range(4)),
+                )
+            )
+    assert st.max_round == 39
+    assert st.path(VertexID(39, 0), VertexID(0, 3), strong_only=True)
+
+
+def test_dense_snapshot_matches_kernels(fig1_state):
+    exists, strong = fig1_state.dense_snapshot()
+    assert exists.shape == (5, 4) and strong.shape == (5, 4, 4)
+    assert exists.all()
+    # strong stack view for the wave-commit kernel: rounds (1, 4] top-first
+    stack = fig1_state.strong_stack(4, 1)
+    assert stack.shape == (3, 4, 4)
+    assert (stack[0] == strong[4]).all()
+    assert (stack[2] == strong[2]).all()

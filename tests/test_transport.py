@@ -1,0 +1,91 @@
+"""Transport broker tests (the reference never tests its Transport at all,
+SURVEY.md §4), including the D12 fixes: sender exclusion, subscribe/broadcast
+race safety, decoupled delivery."""
+
+import threading
+
+from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.transport import InMemoryTransport
+
+
+def _msg(sender=0, rnd=1):
+    return BroadcastMessage(
+        vertex=Vertex(id=VertexID(rnd, sender)), round=rnd, sender=sender
+    )
+
+
+def test_fanout_excludes_sender():
+    tp = InMemoryTransport()
+    got = {i: [] for i in range(3)}
+    for i in range(3):
+        tp.subscribe(i, got[i].append)
+    tp.broadcast(_msg(sender=0))
+    assert tp.pending == 2
+    tp.pump()
+    assert len(got[0]) == 0 and len(got[1]) == 1 and len(got[2]) == 1
+
+
+def test_fifo_order():
+    tp = InMemoryTransport()
+    got = []
+    tp.subscribe(0, got.append)
+    tp.subscribe(1, lambda m: None)
+    for r in range(1, 6):
+        tp.broadcast(_msg(sender=1, rnd=r))
+    tp.pump()
+    assert [m.round for m in got] == [1, 2, 3, 4, 5]
+
+
+def test_duplicate_subscribe_rejected():
+    tp = InMemoryTransport()
+    tp.subscribe(0, lambda m: None)
+    try:
+        tp.subscribe(0, lambda m: None)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_handlers_may_broadcast_reentrantly():
+    """A delivery handler that broadcasts (as Process does) must not
+    deadlock — the broker enqueues outside handler execution."""
+    tp = InMemoryTransport()
+    seen = []
+
+    def echo_once(m):
+        seen.append(m)
+        if m.round < 3:
+            tp.broadcast(_msg(sender=0, rnd=m.round + 1))
+
+    tp.subscribe(0, lambda m: None)
+    tp.subscribe(1, echo_once)
+    tp.broadcast(_msg(sender=0, rnd=1))
+    tp.pump()
+    assert [m.round for m in seen] == [1, 2, 3]
+
+
+def test_concurrent_broadcast_and_subscribe_race_free():
+    """D12: the reference iterates subscribers without a lock while
+    Subscribe appends. Hammer both paths concurrently."""
+    tp = InMemoryTransport()
+    tp.subscribe(0, lambda m: None)
+    stop = threading.Event()
+    errors = []
+
+    def blaster():
+        while not stop.is_set():
+            try:
+                tp.broadcast(_msg(sender=0))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+    t = threading.Thread(target=blaster)
+    t.start()
+    try:
+        for i in range(1, 50):
+            tp.subscribe(i, lambda m: None)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    tp.pump()
