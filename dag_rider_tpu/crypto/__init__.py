@@ -1,0 +1,3 @@
+from dag_rider_tpu.crypto import ed25519
+
+__all__ = ["ed25519"]

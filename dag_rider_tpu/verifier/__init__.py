@@ -1,0 +1,10 @@
+from dag_rider_tpu.verifier.base import KeyRegistry, Verifier, VertexSigner
+from dag_rider_tpu.verifier.cpu import CPUVerifier, NullVerifier
+
+__all__ = [
+    "KeyRegistry",
+    "Verifier",
+    "VertexSigner",
+    "CPUVerifier",
+    "NullVerifier",
+]

@@ -1,0 +1,89 @@
+"""The Verifier seam — the north-star plugin boundary.
+
+BASELINE.json: the per-vertex reliable-broadcast signature verification is
+"lifted behind a new batched Verifier interface, introduced as a sibling to
+the existing Transport plugin boundary" (reference ``process/transport.go:6``
+is the only seam the reference has). A Process takes a Verifier at
+construction and hands it *whole batches* of vertices; backends:
+
+- :class:`dag_rider_tpu.verifier.cpu.CPUVerifier` — host RFC 8032 path,
+- :class:`dag_rider_tpu.verifier.tpu.TPUVerifier` — vmapped JAX limb-field
+  path, one DAG round per device dispatch.
+
+Both must produce **byte-identical accept masks**, which is what makes the
+CPU-vs-TPU commit order byte-identical (the consensus state machine is a
+deterministic function of the accept masks and the delivery schedule).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import List, Optional, Sequence
+
+from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.crypto import ed25519
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyRegistry:
+    """source index -> Ed25519 public key (32 bytes). The PKI the
+    reference's TODO names (``process.go:388``)."""
+
+    public_keys: tuple
+
+    @staticmethod
+    def generate(n: int, seed_prefix: bytes = b"dagrider-test-key-"):
+        """Deterministic test PKI: seeds derived from the index. NOT for
+        production use (seeds are guessable by construction)."""
+        import hashlib
+
+        seeds, pubs = [], []
+        for i in range(n):
+            seed = hashlib.sha256(seed_prefix + str(i).encode()).digest()
+            sk, pk = ed25519.generate_keypair(seed)
+            seeds.append(sk)
+            pubs.append(pk)
+        return KeyRegistry(tuple(pubs)), seeds
+
+    def key_of(self, source: int) -> Optional[bytes]:
+        """Public key of ``source``, or None when out of range — the seam
+        must be total: a bad source yields a reject bit, never an
+        IndexError or (worse) negative-index aliasing to another node's
+        key."""
+        if not 0 <= source < len(self.public_keys):
+            return None
+        return self.public_keys[source]
+
+    @property
+    def n(self) -> int:
+        return len(self.public_keys)
+
+
+class VertexSigner:
+    """Signs this process's own vertices (held by the Process). The key
+    expansion (incl. deriving the public key) is done once here, not per
+    signature."""
+
+    def __init__(self, seed: bytes):
+        self._a, self._prefix, self._A_enc = ed25519.expand_seed(seed)
+
+    @property
+    def public_key(self) -> bytes:
+        return self._A_enc
+
+    def sign_vertex(self, v: Vertex) -> Vertex:
+        sig = ed25519.sign_expanded(
+            self._a, self._prefix, self._A_enc, v.signing_bytes()
+        )
+        return dataclasses.replace(v, signature=sig)
+
+
+class Verifier(abc.ABC):
+    """Batched vertex-signature verification."""
+
+    @abc.abstractmethod
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        """Accept mask, same order as input. Must be a pure function of
+        (vertex bytes, registry) — no randomness — so CPU and TPU backends
+        agree bit-for-bit."""

@@ -1,0 +1,35 @@
+"""CPU Verifier backend — configs #1-2 of the benchmark ladder
+(BASELINE.json: "16-node Ed25519 ... CPU Verifier baseline")."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.crypto import ed25519
+from dag_rider_tpu.verifier.base import KeyRegistry, Verifier
+
+
+class CPUVerifier(Verifier):
+    """Pure-host RFC 8032 verification, one vertex at a time."""
+
+    def __init__(self, registry: KeyRegistry):
+        self.registry = registry
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        items = []
+        for v in vertices:
+            pk = self.registry.key_of(v.source)
+            # missing key / missing signature degrade to un-verifiable
+            # items that ed25519.verify rejects by length — the mask stays
+            # total without a second rejection code path here.
+            items.append((pk or b"", v.signing_bytes(), v.signature or b""))
+        return ed25519.verify_batch(items)
+
+
+class NullVerifier(Verifier):
+    """Accept-everything backend — reproduces the reference's (absent)
+    authentication for differential runs against reference semantics."""
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        return [True] * len(vertices)

@@ -1,0 +1,113 @@
+"""Ed25519 host implementation tests, anchored to RFC 8032 test vectors."""
+
+import pytest
+
+from dag_rider_tpu.crypto import ed25519
+
+
+# RFC 8032 §7.1 test vectors (TEST 1-3).
+RFC_VECTORS = [
+    {
+        "seed": "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "pub": "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "msg": "",
+        "sig": (
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+            "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+        ),
+    },
+    {
+        "seed": "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "pub": "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "msg": "72",
+        "sig": (
+            "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+            "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+        ),
+    },
+    {
+        "seed": "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "pub": "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "msg": "af82",
+        "sig": (
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+            "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+        ),
+    },
+]
+
+
+@pytest.mark.parametrize("vec", RFC_VECTORS, ids=["test1", "test2", "test3"])
+def test_rfc8032_vectors(vec):
+    seed = bytes.fromhex(vec["seed"])
+    msg = bytes.fromhex(vec["msg"])
+    _, pub = ed25519.generate_keypair(seed)
+    assert pub == bytes.fromhex(vec["pub"])
+    sig = ed25519.sign(seed, msg)
+    assert sig == bytes.fromhex(vec["sig"])
+    assert ed25519.verify(pub, msg, sig)
+
+
+def test_verify_rejects_wrong_message_and_key():
+    seed, pub = ed25519.generate_keypair(b"\x01" * 32)
+    sig = ed25519.sign(seed, b"hello")
+    assert ed25519.verify(pub, b"hello", sig)
+    assert not ed25519.verify(pub, b"hellp", sig)
+    _, other = ed25519.generate_keypair(b"\x02" * 32)
+    assert not ed25519.verify(other, b"hello", sig)
+
+
+def test_verify_rejects_tampered_signature():
+    seed, pub = ed25519.generate_keypair(b"\x03" * 32)
+    sig = ed25519.sign(seed, b"msg")
+    bad = bytes([sig[0] ^ 1]) + sig[1:]
+    assert not ed25519.verify(pub, b"msg", bad)
+    # malleability: s >= L rejected
+    s = int.from_bytes(sig[32:], "little")
+    mall = sig[:32] + int.to_bytes(s + ed25519.L, 32, "little")
+    assert not ed25519.verify(pub, b"msg", mall)
+
+
+def test_verify_rejects_garbage_inputs():
+    assert not ed25519.verify(b"\x00" * 32, b"m", b"\x00" * 64)
+    assert not ed25519.verify(b"\x00" * 31, b"m", b"\x00" * 64)
+    assert not ed25519.verify(b"\xff" * 32, b"m", b"\xff" * 64)
+    assert not ed25519.verify(b"\x00" * 32, b"m", b"\x00" * 63)
+
+
+def test_point_ops_consistency():
+    B = ed25519.B
+    assert ed25519.on_curve(B)
+    two_b = ed25519.point_double(B)
+    assert ed25519.on_curve(two_b)
+    assert ed25519.point_equal(two_b, ed25519.point_add(B, B))
+    # [L]B == identity (B generates the prime-order subgroup)
+    assert ed25519.point_equal(
+        ed25519.scalar_mult(ed25519.L, B), ed25519.IDENTITY
+    )
+    # compress/decompress roundtrip
+    for k in (1, 2, 7, 12345):
+        pt = ed25519.scalar_mult(k, B)
+        assert ed25519.point_equal(
+            ed25519.point_decompress(ed25519.point_compress(pt)), pt
+        )
+    # negation: P + (-P) == identity
+    assert ed25519.point_equal(
+        ed25519.point_add(B, ed25519.point_neg(B)), ed25519.IDENTITY
+    )
+
+
+def test_verify_precomputed_matches_full():
+    import hashlib
+
+    seed, pub = ed25519.generate_keypair(b"\x04" * 32)
+    msg = b"split-path"
+    sig = ed25519.sign(seed, msg)
+    k = (
+        int.from_bytes(
+            hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
+        )
+        % ed25519.L
+    )
+    assert ed25519.verify_precomputed(pub, k, sig)
+    assert not ed25519.verify_precomputed(pub, (k + 1) % ed25519.L, sig)
