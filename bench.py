@@ -1,0 +1,78 @@
+"""Benchmark: vertex-signatures verified/sec on one chip (north star).
+
+Prints ONE JSON line:
+  {"metric": "vertex_sigs_per_sec", "value": N, "unit": "sigs/s",
+   "vs_baseline": N / 50000}
+
+BASELINE.json north star: >= 50,000 vertex-signatures verified/sec on a
+single TPU v5e chip at committee size n=256. The measured quantity is the
+steady-state end-to-end Verifier throughput: host prep (SHA-512 challenge
+scalars, byte parsing) + one device dispatch per whole-round batch —
+exactly what the consensus hot path pays per DAG round.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def build_batch(n: int, rounds: int):
+    from dag_rider_tpu.core.types import Block, Vertex, VertexID
+    from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+    from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+    reg, seeds = KeyRegistry.generate(n)
+    signers = [VertexSigner(s) for s in seeds]
+    batches = []
+    for r in range(rounds):
+        vs = []
+        for i in range(n):
+            v = Vertex(
+                id=VertexID(r + 1, i),
+                block=Block((f"r{r}-tx-{i}".encode() * 2,)),
+                strong_edges=tuple(
+                    VertexID(r, s) for s in range(min(n, 2 * ((n - 1) // 3) + 1))
+                ),
+            )
+            vs.append(signers[i].sign_vertex(v))
+        batches.append(vs)
+    return TPUVerifier(reg), batches
+
+
+def main() -> None:
+    n = 256
+    warm_rounds = 2
+    timed_rounds = 8
+    verifier, batches = build_batch(n, warm_rounds + timed_rounds)
+
+    for b in batches[:warm_rounds]:  # compile + warm
+        mask = verifier.verify_batch(b)
+        assert all(mask), "warmup batch failed to verify"
+
+    t0 = time.perf_counter()
+    total = 0
+    for b in batches[warm_rounds:]:
+        mask = verifier.verify_batch(b)
+        total += len(mask)
+        assert all(mask)
+    dt = time.perf_counter() - t0
+
+    sigs_per_sec = total / dt
+    baseline = 50_000.0
+    print(
+        json.dumps(
+            {
+                "metric": "vertex_sigs_per_sec",
+                "value": round(sigs_per_sec, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(sigs_per_sec / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -74,3 +74,73 @@ class RoundRobinCoin(CommonCoin):
 
     def choose_leader(self, wave: int) -> int:
         return wave % self.n
+
+
+class ThresholdCoin(CommonCoin):
+    """(f+1)-of-n threshold-BLS coin (crypto/threshold.py) — the design
+    the reference's TODO names (``process.go:388``).
+
+    Shares arrive piggybacked on round(w,4) vertices via
+    ``observe_share``; the coin becomes ready once f+1 shares combine into
+    a group signature that passes the pairing check. Aggregation is lazy
+    and cached; if a combination fails (a Byzantine share slipped in),
+    shares are verified individually, the bad ones discarded, and the
+    remainder re-combined — so one corrupt share cannot stall the coin.
+    """
+
+    def __init__(self, keys, index: int, n: int, *, msm=None):
+        from dag_rider_tpu.crypto import threshold as th
+
+        self._th = th
+        self.keys = keys
+        self.index = index
+        self.n = n
+        self._msm = msm
+        self._shares: dict = {}
+        self._sigma: dict = {}
+        self._tried_at: dict = {}
+
+    def my_share(self, wave: int):
+        return self._th.sign_share(self.keys.share_sks[self.index], wave)
+
+    def observe_share(self, wave: int, source: int, share: bytes) -> None:
+        if not isinstance(share, (bytes, bytearray)) or len(share) != 48:
+            return
+        self._shares.setdefault(wave, {}).setdefault(source, bytes(share))
+
+    def _try_aggregate(self, wave: int) -> None:
+        if wave in self._sigma:
+            return
+        shares = self._shares.get(wave, {})
+        if len(shares) < self.keys.threshold:
+            return
+        have = frozenset(shares)
+        if self._tried_at.get(wave) == have:
+            return  # no new shares since the last failed attempt
+        self._tried_at[wave] = have
+        sigma = self._th.aggregate(shares, self.keys.threshold, msm=self._msm)
+        if sigma is not None and self._th.verify_group(
+            self.keys.group_pk, wave, sigma
+        ):
+            self._sigma[wave] = sigma
+            return
+        # Byzantine share in the first combination: filter individually.
+        good = {
+            src: sh
+            for src, sh in shares.items()
+            if self._th.verify_share(self.keys.share_pks[src], wave, sh)
+        }
+        self._shares[wave] = good
+        if len(good) >= self.keys.threshold:
+            sigma = self._th.aggregate(good, self.keys.threshold, msm=self._msm)
+            if sigma is not None:
+                self._sigma[wave] = sigma
+
+    def ready(self, wave: int) -> bool:
+        self._try_aggregate(wave)
+        return wave in self._sigma
+
+    def choose_leader(self, wave: int) -> int:
+        if not self.ready(wave):
+            raise RuntimeError(f"coin for wave {wave} not ready")
+        return self._th.leader_from_sigma(self._sigma[wave], self.n)

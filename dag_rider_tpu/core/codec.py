@@ -1,0 +1,103 @@
+"""Wire / storage codec for vertices and broadcast messages.
+
+The reference has no serialization at all — its Transport moves Go structs
+through channels (``process/transport.go:11-18``) and nothing can cross a
+process or persistence boundary (SURVEY.md §5 "checkpoint/resume: absent").
+This codec is the single canonical byte format used by
+
+- the networked Transport (gRPC/TCP), and
+- the checkpoint format (utils/checkpoint.py),
+
+so a checkpointed DAG and an on-the-wire vertex are the same bytes.
+
+Layout (little-endian, length-prefixed): the signed portion reuses
+``Vertex.signing_bytes()`` field order exactly, followed by the signature.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+
+_MAGIC = b"DRv1"
+
+
+def encode_vertex(v: Vertex) -> bytes:
+    out = [_MAGIC, v.id.encode(), v.block.encode()]
+    for edges in (v.strong_edges, v.weak_edges):
+        out.append(struct.pack("<I", len(edges)))
+        for e in sorted(edges):
+            out.append(e.encode())
+    for blob in (v.coin_share, v.signature):
+        if blob is None:
+            out.append(struct.pack("<i", -1))
+        else:
+            out.append(struct.pack("<i", len(blob)))
+            out.append(blob)
+    return b"".join(out)
+
+
+def decode_vertex(data: bytes, offset: int = 0) -> Tuple[Vertex, int]:
+    if data[offset : offset + 4] != _MAGIC:
+        raise ValueError("bad vertex magic")
+    offset += 4
+    rnd, source = struct.unpack_from("<II", data, offset)
+    offset += 8
+    block, offset = Block.decode(data, offset)
+    edge_sets = []
+    for _ in range(2):
+        (count,) = struct.unpack_from("<I", data, offset)
+        offset += 4
+        edges = []
+        for _ in range(count):
+            er, es = struct.unpack_from("<II", data, offset)
+            offset += 8
+            edges.append(VertexID(er, es))
+        edge_sets.append(tuple(edges))
+    blobs = []
+    for _ in range(2):
+        (ln,) = struct.unpack_from("<i", data, offset)
+        offset += 4
+        if ln < 0:
+            blobs.append(None)
+        else:
+            blobs.append(data[offset : offset + ln])
+            offset += ln
+    v = Vertex(
+        id=VertexID(rnd, source),
+        block=block,
+        strong_edges=edge_sets[0],
+        weak_edges=edge_sets[1],
+        coin_share=blobs[0],
+        signature=blobs[1],
+    )
+    return v, offset
+
+
+def encode_message(msg: BroadcastMessage) -> bytes:
+    body = encode_vertex(msg.vertex)
+    return struct.pack("<III", len(body) + 8, msg.round, msg.sender) + body
+
+
+def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]:
+    total, rnd, sender = struct.unpack_from("<III", data, offset)
+    offset += 12
+    v, offset = decode_vertex(data, offset)
+    return BroadcastMessage(vertex=v, round=rnd, sender=sender), offset
+
+
+def frame(payload: bytes) -> bytes:
+    """Length-prefixed frame for stream transports."""
+    return struct.pack("<I", len(payload)) + payload
+
+
+def read_frame(buf: bytes, offset: int = 0) -> Optional[Tuple[bytes, int]]:
+    """Returns (payload, new_offset) or None if the buffer is incomplete."""
+    if len(buf) - offset < 4:
+        return None
+    (ln,) = struct.unpack_from("<I", buf, offset)
+    if len(buf) - offset - 4 < ln:
+        return None
+    return buf[offset + 4 : offset + 4 + ln], offset + 4 + ln

@@ -1,0 +1,593 @@
+"""BLS12-381 — host reference implementation (fields, curves, pairing).
+
+The reference's common coin is a hardcoded stub returning 1
+(``process/process.go:390-392``); its TODO names the real design: "PKI and
+a threshold signature scheme with a threshold of (f+1)-of-n"
+(``process.go:388``). This module supplies the pairing-friendly curve that
+scheme runs on (BASELINE.json: "256-node BLS12-381 aggregate sigs +
+threshold-BLS common coin").
+
+Pure Python ints (CPython bignums), written for auditability over speed:
+
+- Fp / Fp2 / Fp6 / Fp12 tower (u^2 = -1, v^3 = u + 1, w^2 = v);
+- E(Fp): y^2 = x^3 + 4 (G1) and the M-twist E'(Fp2):
+  y^2 = x^3 + 4(u+1) (G2), Jacobian-free affine arithmetic;
+- the ate pairing via a generic Miller loop over E(Fp12) (G2 points are
+  untwisted through (x, y) -> (x w^-2, y w^-3)) and full final
+  exponentiation — slower than a dedicated tower pipeline but easily
+  checked against bilinearity tests;
+- minimal-signature-size BLS: signatures in G1 (48 bytes), public keys in
+  G2; hash-to-G1 by try-and-increment (internal protocol — we control
+  both ends, no interop constraint with the hash-to-curve draft).
+
+The TPU side accelerates the G1 MSM used by threshold-share aggregation
+(ops/bls_msm.py); the pairing checks stay host-side, exactly as ordering
+decisions do (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+# --- base field / curve parameters (standard BLS12-381 constants) ----------
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+X_PARAM = -0xD201000000010000  # the BLS parameter (negative)
+
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+)
+
+
+def _inv_p(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+# --- Fp2 = Fp[u] / (u^2 + 1) ----------------------------------------------
+# elements are (a, b) = a + b u
+
+
+def fp2_add(x, y):
+    return ((x[0] + y[0]) % P, (x[1] + y[1]) % P)
+
+
+def fp2_sub(x, y):
+    return ((x[0] - y[0]) % P, (x[1] - y[1]) % P)
+
+
+def fp2_neg(x):
+    return (-x[0] % P, -x[1] % P)
+
+
+def fp2_mul(x, y):
+    a, b = x
+    c, d = y
+    return ((a * c - b * d) % P, (a * d + b * c) % P)
+
+
+def fp2_sqr(x):
+    a, b = x
+    return ((a + b) * (a - b) % P, 2 * a * b % P)
+
+
+def fp2_scalar(x, k: int):
+    return (x[0] * k % P, x[1] * k % P)
+
+
+def fp2_inv(x):
+    a, b = x
+    norm = (a * a + b * b) % P
+    ni = _inv_p(norm)
+    return (a * ni % P, -b * ni % P)
+
+
+FP2_ZERO = (0, 0)
+FP2_ONE = (1, 0)
+
+
+# --- Fp6 = Fp2[v] / (v^3 - (u+1)) -----------------------------------------
+# elements are (c0, c1, c2) with ci in Fp2; XI = u + 1
+
+XI = (1, 1)
+
+
+def fp6_add(x, y):
+    return tuple(fp2_add(a, b) for a, b in zip(x, y))
+
+
+def fp6_sub(x, y):
+    return tuple(fp2_sub(a, b) for a, b in zip(x, y))
+
+
+def fp6_neg(x):
+    return tuple(fp2_neg(a) for a in x)
+
+
+def fp6_mul(x, y):
+    a0, a1, a2 = x
+    b0, b1, b2 = y
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    c0 = fp2_add(
+        t0,
+        fp2_mul(
+            XI,
+            fp2_sub(
+                fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), fp2_add(t1, t2)
+            ),
+        ),
+    )
+    c1 = fp2_add(
+        fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), fp2_add(t0, t1)),
+        fp2_mul(XI, t2),
+    )
+    c2 = fp2_add(
+        fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), fp2_add(t0, t2)),
+        t1,
+    )
+    return (c0, c1, c2)
+
+
+def fp6_scalar_fp2(x, s):
+    return tuple(fp2_mul(a, s) for a in x)
+
+
+def fp6_mul_by_v(x):
+    """v * (c0 + c1 v + c2 v^2) = XI c2 + c0 v + c1 v^2."""
+    return (fp2_mul(XI, x[2]), x[0], x[1])
+
+
+def fp6_inv(x):
+    a0, a1, a2 = x
+    t0 = fp2_sub(fp2_sqr(a0), fp2_mul(XI, fp2_mul(a1, a2)))
+    t1 = fp2_sub(fp2_mul(XI, fp2_sqr(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    denom = fp2_add(
+        fp2_mul(a0, t0),
+        fp2_mul(XI, fp2_add(fp2_mul(a2, t1), fp2_mul(a1, t2))),
+    )
+    di = fp2_inv(denom)
+    return (fp2_mul(t0, di), fp2_mul(t1, di), fp2_mul(t2, di))
+
+
+FP6_ZERO = (FP2_ZERO, FP2_ZERO, FP2_ZERO)
+FP6_ONE = (FP2_ONE, FP2_ZERO, FP2_ZERO)
+
+
+# --- Fp12 = Fp6[w] / (w^2 - v) --------------------------------------------
+# elements are (c0, c1) with ci in Fp6
+
+
+def fp12_add(x, y):
+    return (fp6_add(x[0], y[0]), fp6_add(x[1], y[1]))
+
+
+def fp12_sub(x, y):
+    return (fp6_sub(x[0], y[0]), fp6_sub(x[1], y[1]))
+
+
+def fp12_mul(x, y):
+    a0, a1 = x
+    b0, b1 = y
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(
+        fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), fp6_add(t0, t1)
+    )
+    return (c0, c1)
+
+
+def fp12_sqr(x):
+    return fp12_mul(x, x)
+
+
+def fp12_inv(x):
+    a0, a1 = x
+    denom = fp6_sub(fp6_mul(a0, a0), fp6_mul_by_v(fp6_mul(a1, a1)))
+    di = fp6_inv(denom)
+    return (fp6_mul(a0, di), fp6_neg(fp6_mul(a1, di)))
+
+
+def fp12_conj(x):
+    """Conjugation c0 - c1 w == x^(p^6) — the cheap inverse for elements
+    in the cyclotomic subgroup (|x| = 1 after the easy exponentiation)."""
+    return (x[0], fp6_neg(x[1]))
+
+
+def fp12_pow(x, e: int):
+    if e < 0:
+        x = fp12_inv(x)
+        e = -e
+    acc = FP12_ONE
+    while e:
+        if e & 1:
+            acc = fp12_mul(acc, x)
+        x = fp12_sqr(x)
+        e >>= 1
+    return acc
+
+
+FP12_ZERO = (FP6_ZERO, FP6_ZERO)
+FP12_ONE = (FP6_ONE, FP6_ZERO)
+
+# w and its inverse powers, used by the untwist map.
+W = (FP6_ZERO, FP6_ONE)  # w
+W2 = (  # w^2 = v
+    (FP2_ZERO, FP2_ONE, FP2_ZERO),
+    FP6_ZERO,
+)
+W2_INV = fp12_inv(W2)
+W3_INV = fp12_inv(fp12_mul(W2, W))
+
+
+def fp12_from_fp2(x) -> tuple:
+    return (((x[0], x[1]), FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+# --- affine curve arithmetic over a generic field --------------------------
+# Points are None (infinity) or (x, y) with coordinates in the field; the
+# field is abstracted by the ops tuple (add, sub, mul, inv, neg, scalar).
+
+
+class _Ops:
+    __slots__ = ("add", "sub", "mul", "inv", "neg", "small")
+
+    def __init__(self, add, sub, mul, inv, neg, small):
+        self.add, self.sub, self.mul, self.inv, self.neg, self.small = (
+            add,
+            sub,
+            mul,
+            inv,
+            neg,
+            small,
+        )
+
+
+_FP_OPS = _Ops(
+    lambda a, b: (a + b) % P,
+    lambda a, b: (a - b) % P,
+    lambda a, b: a * b % P,
+    _inv_p,
+    lambda a: -a % P,
+    lambda a, k: a * k % P,
+)
+_FP2_OPS = _Ops(fp2_add, fp2_sub, fp2_mul, fp2_inv, fp2_neg, fp2_scalar)
+
+
+def _ec_add(ops: _Ops, p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == ops.neg(y2) and y1 != y2:
+            return None
+        if y1 == y2:
+            return _ec_double(ops, p1)
+        return None
+    lam = ops.mul(ops.sub(y2, y1), ops.inv(ops.sub(x2, x1)))
+    x3 = ops.sub(ops.sub(ops.mul(lam, lam), x1), x2)
+    y3 = ops.sub(ops.mul(lam, ops.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _ec_double(ops: _Ops, p1):
+    if p1 is None:
+        return None
+    x1, y1 = p1
+    three_x2 = ops.small(ops.mul(x1, x1), 3)
+    lam = ops.mul(three_x2, ops.inv(ops.small(y1, 2)))
+    x3 = ops.sub(ops.mul(lam, lam), ops.small(x1, 2))
+    y3 = ops.sub(ops.mul(lam, ops.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _ec_mul(ops: _Ops, k: int, p1):
+    if k % R == 0 or p1 is None:
+        return None if k % R == 0 else p1
+    k %= R
+    acc = None
+    while k:
+        if k & 1:
+            acc = _ec_add(ops, acc, p1)
+        p1 = _ec_double(ops, p1)
+        k >>= 1
+    return acc
+
+
+# public G1/G2 ops
+
+
+def g1_add(p1, p2):
+    return _ec_add(_FP_OPS, p1, p2)
+
+
+def g1_double(p1):
+    return _ec_double(_FP_OPS, p1)
+
+
+def g1_mul(k: int, p1=G1_GEN):
+    return _ec_mul(_FP_OPS, k, p1)
+
+
+def g1_neg(p1):
+    return None if p1 is None else (p1[0], -p1[1] % P)
+
+
+def g2_add(p1, p2):
+    return _ec_add(_FP2_OPS, p1, p2)
+
+
+def g2_mul(k: int, p1=G2_GEN):
+    return _ec_mul(_FP2_OPS, k, p1)
+
+
+def g2_neg(p1):
+    return None if p1 is None else (p1[0], fp2_neg(p1[1]))
+
+
+def g1_on_curve(p1) -> bool:
+    if p1 is None:
+        return True
+    x, y = p1
+    return (y * y - x * x * x - 4) % P == 0
+
+
+def g2_on_curve(p1) -> bool:
+    if p1 is None:
+        return True
+    x, y = p1
+    rhs = fp2_add(fp2_mul(fp2_mul(x, x), x), fp2_scalar(XI, 4))
+    return fp2_sub(fp2_mul(y, y), rhs) == (0, 0)
+
+
+# --- pairing ---------------------------------------------------------------
+
+
+def _untwist(q):
+    """E'(Fp2) -> E(Fp12): (x, y) -> (x w^-2, y w^-3)."""
+    if q is None:
+        return None
+    x, y = q
+    return (
+        fp12_mul(fp12_from_fp2(x), W2_INV),
+        fp12_mul(fp12_from_fp2(y), W3_INV),
+    )
+
+
+def _line(ops: _Ops, t, s, p):
+    """Evaluate the line through t and s (or the tangent at t when t == s)
+    at the G1 point p (embedded in Fp12)."""
+    xp, yp = p
+    xt, yt = t
+    if t == s:
+        num = ops.small(ops.mul(xt, xt), 3)
+        den = ops.small(yt, 2)
+    else:
+        xs, ys = s
+        if xt == xs:
+            # vertical line x - xt
+            return ops.sub(xp, xt)
+        num = ops.sub(ys, yt)
+        den = ops.sub(xs, xt)
+    lam = ops.mul(num, ops.inv(den))
+    return ops.sub(ops.sub(yp, yt), ops.mul(lam, ops.sub(xp, xt)))
+
+
+def miller_loop(q, p) -> tuple:
+    """f_{|x|, Q}(P) over E(Fp12), generic double-and-add Miller loop."""
+    if p is None or q is None:
+        return FP12_ONE
+    ops = _Ops(
+        fp12_add,
+        fp12_sub,
+        fp12_mul,
+        fp12_inv,
+        lambda v: fp12_sub(FP12_ZERO, v),
+        lambda v, k: fp12_mul(v, fp12_from_small(k)),
+    )
+    qe = _untwist(q)
+    pe = (fp12_from_fp(p[0]), fp12_from_fp(p[1]))
+    t = qe
+    f = FP12_ONE
+    n = abs(X_PARAM)
+    for bit in bin(n)[3:]:
+        f = fp12_mul(fp12_sqr(f), _line(ops, t, t, pe))
+        t = _ec_double(ops, t)
+        if bit == "1":
+            f = fp12_mul(f, _line(ops, t, qe, pe))
+            t = _ec_add(ops, t, qe)
+    if X_PARAM < 0:
+        f = fp12_conj(f)  # f^(p^6) == f^-1 up to the final exponentiation
+    return f
+
+
+def fp12_from_fp(a: int) -> tuple:
+    return (((a % P, 0), FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+def fp12_from_small(k: int) -> tuple:
+    return fp12_from_fp(k)
+
+
+# Frobenius: u^2 = -1 so conj is the Fp2 Frobenius; v^p = gamma1 * v and
+# w^p = gamma_w * w with the constants below (p == 1 mod 6).
+_GAMMA1 = None
+_GAMMAW = None
+
+
+def _frob_consts():
+    global _GAMMA1, _GAMMAW
+    if _GAMMA1 is None:
+        # XI^((p-1)/3) and XI^((p-1)/6) in Fp2
+        def fp2_pow(x, e):
+            acc = FP2_ONE
+            while e:
+                if e & 1:
+                    acc = fp2_mul(acc, x)
+                x = fp2_sqr(x)
+                e >>= 1
+            return acc
+
+        _GAMMA1 = fp2_pow(XI, (P - 1) // 3)
+        _GAMMAW = fp2_pow(XI, (P - 1) // 6)
+    return _GAMMA1, _GAMMAW
+
+
+def fp2_conj(x):
+    return (x[0], -x[1] % P)
+
+
+def fp12_frobenius(x):
+    """x^p via coefficient-wise conjugation and the twist constants."""
+    g1c, gw = _frob_consts()
+    g2c = fp2_sqr(g1c)
+    (a0, a1, a2), (b0, b1, b2) = x
+    c0 = (fp2_conj(a0), fp2_mul(fp2_conj(a1), g1c), fp2_mul(fp2_conj(a2), g2c))
+    d0 = fp2_mul(fp2_conj(b0), gw)
+    d1 = fp2_mul(fp2_mul(fp2_conj(b1), g1c), gw)
+    d2 = fp2_mul(fp2_mul(fp2_conj(b2), g2c), gw)
+    return (c0, (d0, d1, d2))
+
+
+_HARD_EXP = (P**4 - P**2 + 1) // R
+
+
+def final_exponentiation(f) -> tuple:
+    """f^((p^12-1)/r): easy part via conjugation + Frobenius, hard part by
+    direct exponentiation with (p^4 - p^2 + 1)/r."""
+    # easy: f^((p^6 - 1)(p^2 + 1))
+    f = fp12_mul(fp12_conj(f), fp12_inv(f))       # f^(p^6 - 1)
+    f = fp12_mul(fp12_frobenius(fp12_frobenius(f)), f)  # * f^(p^2)
+    return fp12_pow(f, _HARD_EXP)
+
+
+def pairing(p, q) -> tuple:
+    """e(P, Q) for P in G1, Q in G2 — ate Miller loop + final exp."""
+    return final_exponentiation(miller_loop(q, p))
+
+
+def pairing_check(pairs: Sequence[Tuple[object, object]]) -> bool:
+    """prod e(Pi, Qi) == 1 — the multi-pairing product check. The final
+    exponentiation is shared across the product (the big win of batching
+    pairing checks)."""
+    f = FP12_ONE
+    for p, q in pairs:
+        f = fp12_mul(f, miller_loop(q, p))
+    return final_exponentiation(f) == FP12_ONE
+
+
+# --- serialization (internal format: affine, uncompressed-ish) -------------
+
+
+def g1_compress(p1) -> bytes:
+    """48-byte x with 2 flag bits (internal format, zcash-style layout)."""
+    if p1 is None:
+        return bytes([0xC0] + [0] * 47)
+    x, y = p1
+    flag = 0x80 | (0x20 if y > (P - 1) // 2 else 0)
+    data = bytearray(x.to_bytes(48, "big"))
+    data[0] |= flag
+    return bytes(data)
+
+
+def g1_decompress(data: bytes):
+    """Inverse of g1_compress. Returns None on malformed input (callers
+    treat None as a rejected share)."""
+    if len(data) != 48 or not data[0] & 0x80:
+        return None
+    if data[0] & 0x40:
+        # compressed infinity: never a valid signature (sk == 0), reject
+        return None
+    big_y = bool(data[0] & 0x20)
+    x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if x >= P:
+        return None
+    y2 = (x * x * x + 4) % P
+    y = pow(y2, (P + 1) // 4, P)  # p == 3 (mod 4)
+    if y * y % P != y2:
+        return None
+    if (y > (P - 1) // 2) != big_y:
+        y = P - y
+    return (x, y)
+
+
+def hash_to_g1(msg: bytes, domain: bytes = b"dagrider-coin-v1") -> tuple:
+    """Try-and-increment hash onto the r-torsion of E(Fp).
+
+    Internal-protocol map (deterministic, constant participants): take
+    x = H(domain || ctr || msg) mod p until x^3 + 4 is square, pick the
+    smaller root for determinism, then clear the cofactor by multiplying
+    with h1 = (x-1)^2 / 3 ... here simply multiply by the G1 cofactor.
+    """
+    ctr = 0
+    while True:
+        h = hashlib.sha512(
+            domain + ctr.to_bytes(4, "little") + msg
+        ).digest()
+        x = int.from_bytes(h, "big") % P
+        y2 = (x * x * x + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P == y2:
+            y = min(y, P - y)
+            pt = (x, y)
+            # clear cofactor: h1 = (x_param - 1)^2 // 3
+            h1 = (X_PARAM - 1) ** 2 // 3
+            cleared = _ec_mul_raw(_FP_OPS, h1, pt)
+            if cleared is not None:
+                return cleared
+        ctr += 1
+
+
+def _ec_mul_raw(ops: _Ops, k: int, p1):
+    """Scalar mult WITHOUT reducing k mod R (cofactor clearing needs the
+    raw integer)."""
+    if k < 0:
+        k = -k
+        p1 = (p1[0], ops.neg(p1[1]))
+    acc = None
+    while k:
+        if k & 1:
+            acc = _ec_add(ops, acc, p1)
+        p1 = _ec_double(ops, p1)
+        k >>= 1
+    return acc
+
+
+# --- BLS signatures (minimal-signature-size: sig in G1, pk in G2) ----------
+
+
+def sign(sk: int, msg: bytes) -> bytes:
+    """sigma = sk * H(msg) in G1, compressed to 48 bytes."""
+    return g1_compress(g1_mul(sk, hash_to_g1(msg)))
+
+
+def pk_of(sk: int):
+    return g2_mul(sk, G2_GEN)
+
+
+def verify(pk_g2, msg: bytes, sig: bytes) -> bool:
+    """e(sigma, g2) == e(H(msg), pk)  <=>  e(sigma, -g2) e(H(m), pk) == 1."""
+    s = g1_decompress(sig)
+    if s is None:
+        return False
+    return pairing_check(
+        [(s, g2_neg(G2_GEN)), (hash_to_g1(msg), pk_g2)]
+    )

@@ -1,0 +1,140 @@
+"""(f+1)-of-n threshold-BLS — the real common coin.
+
+Exactly the design the reference's TODO names ("PKI and a threshold
+signature scheme with a threshold of (f+1)-of-n",
+``process/process.go:388``), built on :mod:`dag_rider_tpu.crypto.bls12381`:
+
+- a trusted dealer (or DKG, out of scope) Shamir-shares a group secret
+  over Z_r; process i holds share sk_i = poly(i+1);
+- for wave w, each process signs the wave tag with its share and
+  piggybacks the 48-byte share signature on its round(w,4) vertex;
+- any f+1 valid shares Lagrange-interpolate (in the exponent — a G1
+  multi-scalar multiplication, the TPU-acceleration target of
+  BASELINE.json config #5) to the unique group signature sigma_w;
+- leader(w) = H(sigma_w) mod n. Agreement: sigma_w is unique regardless
+  of which f+1 shares combined. Unpredictability: fewer than f+1 shares
+  reveal nothing (Shamir). Fairness: H(sigma_w) is uniform.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dag_rider_tpu.crypto import bls12381 as bls
+
+_COIN_DOMAIN = b"dagrider-threshold-coin-v1|"
+
+
+def wave_tag(wave: int) -> bytes:
+    return _COIN_DOMAIN + wave.to_bytes(8, "little")
+
+
+class ThresholdKeys:
+    """Dealer-generated key material for one committee.
+
+    share_sks[i] is private to process i; group_pk and share_pks are the
+    public PKI every process (and any external verifier) holds.
+    """
+
+    def __init__(
+        self,
+        threshold: int,
+        group_pk,
+        share_pks: Sequence,
+        share_sks: Sequence[int],
+    ):
+        self.threshold = threshold
+        self.group_pk = group_pk
+        self.share_pks = tuple(share_pks)
+        self.share_sks = tuple(share_sks)
+
+    @staticmethod
+    def generate(
+        n: int, threshold: int, seed: bytes = b"dagrider-coin-dealer"
+    ) -> "ThresholdKeys":
+        """Deterministic dealer (seeded — tests / simulations only; a real
+        deployment runs a DKG so nobody ever holds the group secret)."""
+        if not 1 <= threshold <= n:
+            raise ValueError("need 1 <= threshold <= n")
+        coeffs = []
+        for j in range(threshold):
+            h = hashlib.sha512(seed + b"|coeff|" + str(j).encode()).digest()
+            coeffs.append(int.from_bytes(h, "little") % bls.R)
+        def poly(x: int) -> int:
+            acc = 0
+            for c in reversed(coeffs):
+                acc = (acc * x + c) % bls.R
+            return acc
+
+        share_sks = [poly(i + 1) for i in range(n)]
+        share_pks = [bls.pk_of(sk) for sk in share_sks]
+        return ThresholdKeys(
+            threshold, bls.pk_of(coeffs[0]), share_pks, share_sks
+        )
+
+
+def sign_share(share_sk: int, wave: int) -> bytes:
+    """Process-local share signature for wave w (48 bytes)."""
+    return bls.sign(share_sk, wave_tag(wave))
+
+
+def verify_share(share_pk, wave: int, share: bytes) -> bool:
+    """Pairing check of one share against that process's share pk."""
+    return bls.verify(share_pk, wave_tag(wave), share)
+
+
+def lagrange_at_zero(indices: Sequence[int]) -> List[int]:
+    """Coefficients lambda_i for interpolation at x=0 over Z_r; indices
+    are the Shamir x-coordinates (process index + 1)."""
+    lams = []
+    for i in indices:
+        num, den = 1, 1
+        for j in indices:
+            if j == i:
+                continue
+            num = num * j % bls.R
+            den = den * (j - i) % bls.R
+        lams.append(num * pow(den, bls.R - 2, bls.R) % bls.R)
+    return lams
+
+
+def aggregate(
+    shares: Dict[int, bytes], threshold: int, *, msm=None
+) -> Optional[bytes]:
+    """Combine >= threshold shares {source -> 48B sig} into the group
+    signature. Returns None if fewer than threshold decode.
+
+    The combination sigma = sum_i lambda_i * sigma_i is a G1 MSM; `msm`
+    may override the backend (host double-and-add by default, the TPU
+    kernel via ops.bls_msm when supplied).
+    """
+    decoded: List[Tuple[int, tuple]] = []
+    for src in sorted(shares):
+        pt = bls.g1_decompress(shares[src])
+        if pt is not None:
+            decoded.append((src, pt))
+        if len(decoded) == threshold:
+            break
+    if len(decoded) < threshold:
+        return None
+    xs = [src + 1 for src, _ in decoded]
+    lams = lagrange_at_zero(xs)
+    points = [pt for _, pt in decoded]
+    if msm is not None:
+        sigma = msm(lams, points)
+    else:
+        sigma = None
+        for lam, pt in zip(lams, points):
+            sigma = bls.g1_add(sigma, bls.g1_mul(lam, pt))
+    return bls.g1_compress(sigma)
+
+
+def verify_group(group_pk, wave: int, sigma: bytes) -> bool:
+    return bls.verify(group_pk, wave_tag(wave), sigma)
+
+
+def leader_from_sigma(sigma: bytes, n: int) -> int:
+    """H(sigma) mod n — uniform because sigma is a uniform group element
+    determined before any adversary sees f+1 shares."""
+    return int.from_bytes(hashlib.sha512(sigma).digest(), "little") % n

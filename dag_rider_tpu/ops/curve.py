@@ -1,0 +1,260 @@
+"""Edwards25519 group arithmetic on the limb field — device-side Ed25519.
+
+Implements the group layer of the north-star TPU Verifier (BASELINE.json:
+"vmap'd Ed25519 ... batch-verify ... one DAG round per device dispatch"):
+point add/double in extended homogeneous coordinates, RFC 8032 §5.1.3
+point decompression (square root via exponentiation — no data-dependent
+control flow), fixed-base scalar multiplication of B from a precomputed
+radix-16 comb table, and 4-bit-windowed variable-base scalar multiplication.
+
+Everything is pure jnp over the signed-limb field of
+:mod:`dag_rider_tpu.ops.field`, shape-polymorphic over leading batch dims,
+jit-safe (static shapes, `fori_loop` for the window walks). The host oracle
+(:mod:`dag_rider_tpu.crypto.ed25519`, RFC 8032 in python ints) uses the
+*same* formulas, which is what makes CPU and TPU accept masks
+byte-identical (SURVEY.md §7 hard part (b)).
+
+A "point" is a tuple (X, Y, Z, T) of limb arrays [..., 22]; x = X/Z,
+y = Y/Z, T = XY/Z (extended homogeneous coordinates, RFC 8032 §5.1.4).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dag_rider_tpu.ops import field as F
+
+Point = Tuple[jax.Array, jax.Array, jax.Array, jax.Array]
+
+WINDOWS = 64  # 256-bit scalars, 4-bit windows
+
+
+def identity(shape=(), like: jax.Array | None = None) -> Point:
+    """The neutral element (0, 1, 1, 0), broadcast to leading `shape`."""
+    zero = jnp.broadcast_to(jnp.asarray(F.ZERO), (*shape, F.LIMBS))
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), (*shape, F.LIMBS))
+    return (zero, one, one, zero)
+
+
+def padd(p: Point, q: Point) -> Point:
+    """Unified addition (add-2008-hwcd-3 for a=-1) — complete on the curve;
+    identical formulas to the host oracle's ``point_add``
+    (crypto/ed25519.py), so results agree bit-for-bit after canonical()."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
+    b = F.mul(F.add(Y1, X1), F.add(Y2, X2))
+    c = F.mul(F.mul(T1, T2), jnp.asarray(F.D2))
+    d = F.mul_small(F.mul(Z1, Z2), 2)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def pdouble(p: Point) -> Point:
+    """Doubling (dbl-2008-hwcd), same formulas as host ``point_double``."""
+    X1, Y1, Z1, _ = p
+    a = F.square(X1)
+    b = F.square(Y1)
+    c = F.mul_small(F.square(Z1), 2)
+    h = F.add(a, b)
+    e = F.sub(h, F.square(F.add(X1, Y1)))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def pselect(cond: jax.Array, p: Point, q: Point) -> Point:
+    """cond ? p : q, element-wise over the batch."""
+    return tuple(F.select(cond, a, b) for a, b in zip(p, q))
+
+
+def pneg(p: Point) -> Point:
+    X, Y, Z, T = p
+    return (F.neg(X), Y, Z, F.neg(T))
+
+
+def points_equal(p: Point, q: Point) -> jax.Array:
+    """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1 (mod p) —
+    the device twin of host ``point_equal``."""
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    ex = F.is_zero(F.sub(F.mul(X1, Z2), F.mul(X2, Z1)))
+    ey = F.is_zero(F.sub(F.mul(Y1, Z2), F.mul(Y2, Z1)))
+    return ex & ey
+
+
+# ---------------------------------------------------------------------------
+# Decompression (RFC 8032 §5.1.3) — branch-free
+# ---------------------------------------------------------------------------
+
+
+def decompress(y: jax.Array, sign: jax.Array) -> Tuple[Point, jax.Array]:
+    """Recover (x, y) from the y limbs + sign bit; returns (point, valid).
+
+    Candidate square root of u/v computed as u v^3 (u v^7)^((p-5)/8)
+    (RFC 8032's inversion-free form). Mirrors the host ``_recover_x``
+    decision tree exactly, branch-free:
+
+    - no root (v x^2 != ±u)            -> invalid
+    - x == 0 with sign bit set         -> invalid (the host's
+      ``return None if sign else 0`` arm)
+    - parity(x) != sign                -> x := p - x
+
+    The caller is responsible for the y < p canonicity check (done on the
+    host from the raw bytes, where it is one integer compare).
+    """
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), y.shape)
+    y2 = F.square(y)
+    u = F.sub(y2, one)                      # y^2 - 1
+    v = F.add(F.mul(y2, jnp.asarray(F.D)), one)  # d y^2 + 1
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    cand = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
+    vxx = F.mul(v, F.square(cand))
+    root1 = F.eq(vxx, u)
+    root2 = F.eq(vxx, F.neg(u))
+    x = F.select(root1, cand, F.mul(cand, jnp.asarray(F.SQRT_M1)))
+    valid = root1 | root2
+    x_zero = F.is_zero(x)
+    valid = valid & ~(x_zero & (sign == 1))
+    flip = F.parity(x) != sign
+    x = F.select(flip, F.neg(x), x)
+    z = jnp.broadcast_to(jnp.asarray(F.ONE), y.shape)
+    return (x, y, z, F.mul(x, y)), valid
+
+
+# ---------------------------------------------------------------------------
+# Scalar multiplication
+# ---------------------------------------------------------------------------
+
+
+def _gather_point(table: Tuple[jax.Array, ...], idx: jax.Array) -> Point:
+    """table: per-coord arrays [..., 16, 22]; idx: int32[...] in [0, 16)."""
+    out = []
+    for coord in table:
+        g = jnp.take_along_axis(
+            coord, idx[..., None, None].astype(jnp.int32), axis=-2
+        )
+        out.append(g[..., 0, :])
+    return tuple(out)
+
+
+def scalar_mul_var(nibbles: jax.Array, a: Point) -> Point:
+    """[k]A for per-element points A — 4-bit fixed windows, MSB first.
+
+    nibbles: int32[..., 64], little-endian (nibbles[..., 0] = k & 0xF).
+    252 doublings + 63 adds + 14 table-build adds, all batched; the window
+    walk is a fori_loop so the HLO stays one window long.
+    """
+    # Window table 0..15: T[d] = d * A.
+    entries = [identity(nibbles.shape[:-1]), a]
+    for _ in range(14):
+        entries.append(padd(entries[-1], a))
+    table = tuple(
+        jnp.stack([e[c] for e in entries], axis=-2) for c in range(4)
+    )
+
+    def body(i, acc):
+        acc = pdouble(pdouble(pdouble(pdouble(acc))))
+        idx = jnp.take(nibbles, WINDOWS - 1 - i, axis=-1)
+        return padd(acc, _gather_point(table, idx))
+
+    return jax.lax.fori_loop(
+        0, WINDOWS, body, identity(nibbles.shape[:-1])
+    )
+
+
+# Fixed-base comb table for B: TABLE[i][d] = d * 2^(4i) * B, affine
+# (Z == 1), as numpy limb arrays [64, 16, 22] per coordinate (X, Y, T).
+_B_TABLE: Tuple[np.ndarray, np.ndarray, np.ndarray] | None = None
+
+
+def _build_b_table() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    from dag_rider_tpu.crypto import ed25519 as host
+
+    xs = np.zeros((WINDOWS, 16, F.LIMBS), dtype=np.int32)
+    ys = np.zeros((WINDOWS, 16, F.LIMBS), dtype=np.int32)
+    ts = np.zeros((WINDOWS, 16, F.LIMBS), dtype=np.int32)
+    base = host.B
+    for i in range(WINDOWS):
+        acc = host.IDENTITY
+        for d in range(16):
+            X, Y, Z, _ = acc
+            zi = pow(Z, F.P_INT - 2, F.P_INT)
+            x = X * zi % F.P_INT
+            y = Y * zi % F.P_INT
+            xs[i, d] = F.to_limbs(x)
+            ys[i, d] = F.to_limbs(y)
+            ts[i, d] = F.to_limbs(x * y % F.P_INT)
+            acc = host.point_add(acc, base)
+        for _ in range(4):
+            base = host.point_double(base)
+    return xs, ys, ts
+
+
+def b_table() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Lazy host-side comb-table build (~1.2k host point ops, one-time)."""
+    global _B_TABLE
+    if _B_TABLE is None:
+        _B_TABLE = _build_b_table()
+    return _B_TABLE
+
+
+def scalar_mul_base(nibbles: jax.Array) -> Point:
+    """[s]B via the comb table: 64 adds, zero doublings.
+
+    nibbles: int32[..., 64] little-endian. acc = sum_i TABLE[i][s_i].
+    """
+    xs, ys, ts = (jnp.asarray(t) for t in b_table())
+    batch_shape = nibbles.shape[:-1]
+
+    def body(i, acc):
+        # per-window affine entry, gathered per batch element
+        nib = jnp.take(nibbles, i, axis=-1).astype(jnp.int32)
+        tab = tuple(
+            jnp.take(coord[i], nib, axis=0)  # [16, 22] gathered -> [..., 22]
+            for coord in (xs, ys, ts)
+        )
+        one = jnp.broadcast_to(jnp.asarray(F.ONE), (*batch_shape, F.LIMBS))
+        entry = (tab[0], tab[1], one, tab[2])
+        return padd(acc, entry)
+
+    return jax.lax.fori_loop(0, WINDOWS, body, identity(batch_shape))
+
+
+# ---------------------------------------------------------------------------
+# The verify equation
+# ---------------------------------------------------------------------------
+
+
+def verify_core(
+    s_nibbles: jax.Array,
+    k_nibbles: jax.Array,
+    a_point: Point,
+    a_valid: jax.Array,
+    r_y: jax.Array,
+    r_sign: jax.Array,
+    prevalid: jax.Array,
+) -> jax.Array:
+    """Batched non-cofactored Ed25519 check: [s]B == R + [k]A.
+
+    Args are per-batch-element device arrays; hashing (k), scalar range
+    checks (s < L) and byte parsing happen on the host (SURVEY.md §7:
+    ordering decisions host-side, device returns only accept bits).
+
+    Returns bool[...] accept mask — ANDed with `a_valid` (public key
+    decompressed OK), R decompression validity, and `prevalid` (host-side
+    structural checks).
+    """
+    r_point, r_valid = decompress(r_y, r_sign)
+    lhs = scalar_mul_base(s_nibbles)
+    ka = scalar_mul_var(k_nibbles, a_point)
+    rhs = padd(r_point, ka)
+    return points_equal(lhs, rhs) & a_valid & r_valid & prevalid

@@ -1,0 +1,48 @@
+"""Device mesh helpers.
+
+The reference's only comm stack is an in-memory channel broker
+(``process/transport.go``) — host-side consensus traffic stays host-side
+here too (gRPC / in-memory Transport). What *does* scale across chips is
+the crypto batch work (SURVEY.md §2b): verify batches shard over a 1-D
+"batch" mesh (data-parallel over a round's <= n vertices), and large-n MSM
+work shards the same way. Collectives ride ICI via XLA — there is no
+hand-written NCCL/MPI analog to port.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Tuple[str, ...] = ("batch",),
+) -> Mesh:
+    """A device mesh over the first ``n_devices`` (default: all).
+
+    shape defaults to 1-D ``(n_devices,)`` — verify batches are purely
+    data-parallel, so one axis is the common case; pass e.g. shape=(4, 2),
+    axis_names=("batch", "shard") to split MSM work within a batch row.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    devs = devs[:n_devices]
+    if shape is None:
+        shape = (n_devices,)
+    import numpy as np
+
+    return Mesh(np.asarray(devs).reshape(shape), axis_names)
+
+
+def batch_sharding(mesh: Mesh, axis: str = "batch") -> NamedSharding:
+    """Shard a batch-leading array over the mesh's batch axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

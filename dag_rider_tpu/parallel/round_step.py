@@ -1,0 +1,70 @@
+"""The fused per-round device step — our "training step" analog.
+
+One DAG round per device dispatch (the north-star shape): verify the
+round's vertex-signature batch (data-parallel over the mesh's batch axis)
+and evaluate the wave-commit quorum kernels (small [n, n] boolean matmuls,
+replicated) in a single jitted program. The host state machine consumes
+(accept_mask, commit, votes) and makes all ordering decisions
+(SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from dag_rider_tpu.ops import curve, dag_kernels, field
+
+
+def make_round_step(mesh: Mesh, *, quorum: int):
+    """Build the jitted sharded round step for a given mesh.
+
+    Inputs (leading dim B sharded over "batch"; DAG tensors replicated):
+      s_nibbles[B,64] k_nibbles[B,64] a_x/a_y/a_t[B,22] a_valid[B]
+      r_y[B,22] r_sign[B] prevalid[B]  — the verify batch;
+      strong_wave[3,n,n] exists_r4[n] leader[]  — the wave-commit inputs.
+
+    Returns (accept_mask[B], commit[], votes[n]).
+    """
+    batch = NamedSharding(mesh, PartitionSpec("batch"))
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(batch,) * 9 + (repl,) * 3,
+        out_shardings=(batch, repl, repl),
+    )
+    def round_step(
+        s_nibbles,
+        k_nibbles,
+        a_x,
+        a_y,
+        a_t,
+        a_valid,
+        r_y,
+        r_sign,
+        prevalid,
+        strong_wave,
+        exists_r4,
+        leader,
+    ):
+        one = jnp.broadcast_to(jnp.asarray(field.ONE), a_x.shape)
+        accept = curve.verify_core(
+            s_nibbles,
+            k_nibbles,
+            (a_x, a_y, one, a_t),
+            a_valid,
+            r_y,
+            r_sign,
+            prevalid,
+        )
+        commit, votes = dag_kernels.wave_commit_votes(
+            strong_wave, exists_r4, leader, quorum=quorum
+        )
+        return accept, commit, votes
+
+    return round_step

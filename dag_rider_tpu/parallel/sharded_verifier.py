@@ -1,0 +1,74 @@
+"""Multi-chip sharded Verifier — data-parallel verify over a device mesh.
+
+The n=1024 rung of the benchmark ladder (BASELINE.json: "1024-node
+full-wave MSM, multi-host pmap on v5e-16" — here pjit/NamedSharding, the
+modern spelling): one DAG round's vertex batch is laid out [B, ...] and
+sharded over the mesh's "batch" axis, so each chip verifies B/n_chips
+signatures; the accept mask gathers back to host. No cross-chip
+collectives are needed in the verify itself (it is embarrassingly
+data-parallel) — XLA inserts the result all-gather; ICI carries it.
+
+Byte-identical masks: the device program is the same
+``curve.verify_core`` regardless of sharding, so CPU / 1-chip / N-chip
+runs agree bit-for-bit (test_parallel.py asserts this on the virtual
+8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.ops import curve, field
+from dag_rider_tpu.parallel.mesh import batch_sharding, make_mesh
+from dag_rider_tpu.verifier.base import KeyRegistry
+from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+
+class ShardedTPUVerifier(TPUVerifier):
+    """TPUVerifier whose device dispatch shards the batch over a mesh."""
+
+    def __init__(self, registry: KeyRegistry, mesh: Optional[Mesh] = None):
+        super().__init__(registry)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._n_shards = int(np.prod(self.mesh.devices.shape))
+        sharding = batch_sharding(self.mesh)
+
+        @functools.partial(
+            jax.jit,
+            in_shardings=(sharding,) * 9,
+            out_shardings=sharding,
+        )
+        def _sharded_verify(
+            s_nibbles, k_nibbles, a_x, a_y, a_t, a_valid, r_y, r_sign, prevalid
+        ):
+            one = jnp.broadcast_to(jnp.asarray(field.ONE), a_x.shape)
+            a_point = (a_x, a_y, one, a_t)
+            return curve.verify_core(
+                s_nibbles, k_nibbles, a_point, a_valid, r_y, r_sign, prevalid
+            )
+
+        self._sharded_verify = _sharded_verify
+
+    def _bucket_size(self, n: int) -> int:
+        # pad to a multiple of the mesh so every shard gets equal work
+        b = self._n_shards
+        while b < n or b < 16:
+            b *= 2
+        return b
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        if not vertices:
+            return []
+        size = self._bucket_size(len(vertices))
+        args = self._prepare(vertices, size)
+        mask = np.asarray(
+            self._sharded_verify(*(jnp.asarray(a) for a in args))
+        )
+        return [bool(m) for m in mask[: len(vertices)]]

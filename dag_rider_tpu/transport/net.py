@@ -1,0 +1,163 @@
+"""gRPC networked Transport — the multi-host deployment backend.
+
+The reference's broker only works inside one OS process (Go channels,
+``process/transport.go``); SURVEY.md §2c calls for "(a) process-to-process
+consensus traffic stays host-side (gRPC), preserving Transport as an
+interface with in-memory (test) and networked implementations". This is
+that networked implementation.
+
+No generated protobuf stubs: the wire payload is the framework's own
+canonical codec (core/codec.py) carried through gRPC's generic byte-level
+method handlers — one unary method ``/dagrider.Transport/Deliver``. That
+keeps the build dependency-free (no grpc_tools in the image) while staying
+a real gRPC service (HTTP/2, deadlines, auth hooks all available).
+
+Delivery model matches InMemoryTransport: incoming RPCs land in an inbox;
+the owner thread pumps them into the Process. The consensus state machine
+stays single-threaded (SURVEY.md D4's fix) — only the inbox is shared.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, Dict, Optional
+
+import grpc
+
+from dag_rider_tpu.core import codec
+from dag_rider_tpu.core.types import BroadcastMessage
+from dag_rider_tpu.transport.base import Handler, Transport
+
+_SERVICE = "dagrider.Transport"
+_METHOD = f"/{_SERVICE}/Deliver"
+
+_identity = lambda b: b  # noqa: E731 — bytes in, bytes out
+
+
+class _DeliverHandler(grpc.GenericRpcHandler):
+    def __init__(self, sink: Callable[[bytes], None]):
+        self._sink = sink
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != _METHOD:
+            return None
+
+        def unary(request: bytes, context) -> bytes:
+            self._sink(request)
+            return b"\x01"
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary, request_deserializer=_identity, response_serializer=_identity
+        )
+
+
+class GrpcTransport(Transport):
+    """One endpoint per process.
+
+    Unlike the in-memory broker (one shared object), each process owns a
+    GrpcTransport bound to its listen address with a peer table of the
+    other processes' addresses — the deployment shape of a real committee.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        listen_addr: str,
+        peers: Dict[int, str],
+        *,
+        max_workers: int = 4,
+    ):
+        self.index = index
+        self._peers = dict(peers)
+        self._handler: Optional[Handler] = None
+        self._lock = threading.Lock()
+        self._inbox: Deque[BroadcastMessage] = deque()
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._stubs: Dict[int, Callable] = {}
+        self._inflight: list = []
+        from concurrent import futures
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((_DeliverHandler(self._on_rpc),))
+        self.bound_port = self._server.add_insecure_port(listen_addr)
+        self._server.start()
+
+    # -- wire ----------------------------------------------------------------
+
+    def _on_rpc(self, payload: bytes) -> None:
+        try:
+            msg, _ = codec.decode_message(payload)
+        except Exception:
+            return  # malformed bytes from a Byzantine peer: drop
+        with self._lock:
+            self._inbox.append(msg)
+
+    def _stub(self, peer: int):
+        if peer not in self._stubs:
+            chan = grpc.insecure_channel(self._peers[peer])
+            self._channels[peer] = chan
+            self._stubs[peer] = chan.unary_unary(
+                _METHOD,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+        return self._stubs[peer]
+
+    # -- Transport interface -------------------------------------------------
+
+    def subscribe(self, index: int, handler: Handler) -> None:
+        if index != self.index:
+            raise ValueError(
+                f"GrpcTransport {self.index} can only host its own process"
+            )
+        if self._handler is not None:
+            raise ValueError("already subscribed")
+        self._handler = handler
+
+    def broadcast(self, msg: BroadcastMessage) -> None:
+        payload = codec.encode_message(msg)
+        for peer in sorted(self._peers):
+            if peer == self.index:
+                continue
+            try:
+                # async send; the future must be retained until it settles
+                # (grpc cancels calls whose handle is dropped). Consensus
+                # tolerates drops — a missing vertex only delays admission
+                # until a later broadcast covers it.
+                fut = self._stub(peer).future(payload, timeout=5.0)
+                self._inflight.append(fut)
+            except grpc.RpcError:
+                pass
+        self._inflight = [f for f in self._inflight if not f.done()]
+
+    # -- pump (same contract as InMemoryTransport) ---------------------------
+
+    def pump_one(self) -> bool:
+        with self._lock:
+            if not self._inbox:
+                return False
+            msg = self._inbox.popleft()
+        if self._handler is not None:
+            self._handler(msg)
+        return True
+
+    def pump(self, max_messages: Optional[int] = None) -> int:
+        delivered = 0
+        while (
+            max_messages is None or delivered < max_messages
+        ) and self.pump_one():
+            delivered += 1
+        return delivered
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._inbox)
+
+    def close(self) -> None:
+        self._server.stop(grace=None)
+        for chan in self._channels.values():
+            chan.close()

@@ -1,0 +1,133 @@
+"""Checkpoint / resume for a Process.
+
+The reference keeps all state in memory with no serialization (SURVEY.md
+§5 "checkpoint/resume: absent"); a crashed reference process loses its DAG
+and cannot rejoin. Here the DAG's dense tensor encoding doubles as the
+checkpoint format (SURVEY.md §7): ``exists``/``strong`` go into one
+compressed ``.npz``, vertex payloads/signatures ride the canonical wire
+codec (core/codec.py), and scalar cursors (round, decided_wave, delivered
+log) go into a JSON manifest. A resumed process continues from the exact
+commit point: delivered_log, buffered vertices and pending blocks are all
+restored, so no vertex is a_delivered twice across a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from dag_rider_tpu.core import codec
+from dag_rider_tpu.core.types import Block, VertexID
+
+MANIFEST = "manifest.json"
+TENSORS = "dag.npz"
+VERTICES = "vertices.bin"
+
+
+def save(process, path: str) -> None:
+    """Write a consistent snapshot of ``process`` into directory ``path``.
+
+    Must be called from the process's own thread (the state machine is
+    synchronous — SURVEY.md D4's fix keeps all mutation on one thread, so
+    a call between step()s sees a consistent state).
+    """
+    os.makedirs(path, exist_ok=True)
+    exists, strong = process.dag.dense_snapshot()
+    np.savez_compressed(
+        os.path.join(path, TENSORS), exists=exists, strong=strong
+    )
+    with open(os.path.join(path, VERTICES), "wb") as fh:
+        for v in process.dag.vertices.values():
+            payload = codec.encode_vertex(v)
+            fh.write(struct.pack("<I", len(payload)))
+            fh.write(payload)
+        # buffered (not yet admitted) vertices, tagged separately
+        for v in process.buffer:
+            payload = codec.encode_vertex(v)
+            fh.write(struct.pack("<I", len(payload) | 0x80000000))
+            fh.write(payload)
+    manifest = {
+        "version": 1,
+        "index": process.index,
+        "n": process.cfg.n,
+        "round": process.round,
+        "decided_wave": process.decided_wave,
+        "delivered_log": [
+            [vid.round, vid.source] for vid in process.delivered_log
+        ],
+        "waves_tried": sorted(process._waves_tried),
+        "blocks_to_propose": [
+            [tx.hex() for tx in b.transactions]
+            for b in process.blocks_to_propose
+        ],
+        "metrics": process.metrics.snapshot(),
+    }
+    tmp = os.path.join(path, MANIFEST + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+    os.replace(tmp, os.path.join(path, MANIFEST))
+
+
+def restore(process, path: str) -> None:
+    """Load a snapshot into a freshly constructed (same cfg/index) Process.
+
+    The process must not have been started; its genesis-only DAG is
+    replaced wholesale by the checkpointed one.
+    """
+    with open(os.path.join(path, MANIFEST)) as fh:
+        manifest = json.load(fh)
+    if manifest["n"] != process.cfg.n or manifest["index"] != process.index:
+        raise ValueError(
+            "checkpoint is for a different committee/process: "
+            f"n={manifest['n']} index={manifest['index']}"
+        )
+    with open(os.path.join(path, VERTICES), "rb") as fh:
+        data = fh.read()
+    offset = 0
+    admitted, buffered = [], []
+    while offset < len(data):
+        (tag,) = struct.unpack_from("<I", data, offset)
+        offset += 4
+        ln = tag & 0x7FFFFFFF
+        v, _ = codec.decode_vertex(data[offset : offset + ln])
+        offset += ln
+        (buffered if tag & 0x80000000 else admitted).append(v)
+    # Rebuild the DAG in round order so insert()'s invariants hold.
+    process.dag.vertices.clear()
+    process.dag.exists[:] = False
+    process.dag.strong[:] = False
+    process.dag.weak.clear()
+    process.dag.max_round = 0
+    for v in sorted(admitted, key=lambda v: (v.round, v.source)):
+        process.dag.insert(v)
+        if v.round >= 1:
+            process._seen_digests[v.id] = v.digest()
+            process._observe_coin_share(v)
+    for v in buffered:
+        process._admit_to_buffer(v)
+        process._seen_digests[v.id] = v.digest()
+    process.round = manifest["round"]
+    process.decided_wave = manifest["decided_wave"]
+    process._waves_tried = set(manifest["waves_tried"])
+    process.delivered_log = [
+        VertexID(r, s) for r, s in manifest["delivered_log"]
+    ]
+    process.delivered = set(process.delivered_log)
+    process.blocks_to_propose.clear()
+    for txs in manifest["blocks_to_propose"]:
+        process.blocks_to_propose.append(
+            Block(tuple(bytes.fromhex(tx) for tx in txs))
+        )
+
+
+def latest_round(path: str) -> Optional[int]:
+    """Peek a checkpoint's round cursor without loading it."""
+    try:
+        with open(os.path.join(path, MANIFEST)) as fh:
+            return json.load(fh)["round"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        return None

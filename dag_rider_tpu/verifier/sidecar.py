@@ -1,0 +1,129 @@
+"""gRPC Verifier sidecar — the north star's deployment shape.
+
+BASELINE.json: "The TPU Verifier impl ships whole-round vertex batches
+over gRPC to a JAX sidecar that runs vmap'd Ed25519 ... batch-verify".
+Two halves:
+
+- :class:`VerifierSidecarServer` — hosts any Verifier backend (normally
+  :class:`~dag_rider_tpu.verifier.tpu.TPUVerifier` pinned to the chip)
+  behind one unary method ``/dagrider.Verifier/VerifyBatch``;
+- :class:`RemoteVerifier` — a drop-in Verifier whose ``verify_batch``
+  round-trips the batch to the sidecar.
+
+Wire format (no protobuf codegen in the image — generic byte handlers,
+like transport/net.py): request = concatenated length-prefixed frames of
+codec-encoded vertices; response = one byte per vertex (0x00/0x01 mask).
+The mask therefore stays byte-identical across in-process CPU, in-process
+TPU, and remote-TPU verifier placements.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Sequence
+
+import grpc
+
+from dag_rider_tpu.core import codec
+from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.verifier.base import Verifier
+
+_METHOD = "/dagrider.Verifier/VerifyBatch"
+_identity = lambda b: b  # noqa: E731
+
+
+def _encode_batch(vertices: Sequence[Vertex]) -> bytes:
+    return b"".join(codec.frame(codec.encode_vertex(v)) for v in vertices)
+
+
+def _decode_batch(payload: bytes) -> List[Vertex]:
+    out: List[Vertex] = []
+    offset = 0
+    while offset < len(payload):
+        item = codec.read_frame(payload, offset)
+        if item is None:
+            raise ValueError("truncated batch frame")
+        blob, offset = item
+        out.append(codec.decode_vertex(blob)[0])
+    return out
+
+
+class _VerifyHandler(grpc.GenericRpcHandler):
+    def __init__(self, backend: Verifier):
+        self._backend = backend
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != _METHOD:
+            return None
+
+        def unary(request: bytes, context) -> bytes:
+            try:
+                batch = _decode_batch(request)
+            except ValueError:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed batch"
+                )
+            mask = self._backend.verify_batch(batch)
+            return bytes(1 if ok else 0 for ok in mask)
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary, request_deserializer=_identity, response_serializer=_identity
+        )
+
+
+class VerifierSidecarServer:
+    """Hosts a Verifier backend on an insecure local port (the sidecar
+    lives on the same machine/pod as the consensus host; transport auth is
+    a deployment concern layered via gRPC creds if needed)."""
+
+    def __init__(self, backend: Verifier, listen_addr: str = "127.0.0.1:0"):
+        from concurrent import futures
+
+        # one worker: device dispatches serialize anyway, and a single
+        # thread keeps per-backend batching deterministic.
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=1))
+        self._server.add_generic_rpc_handlers((_VerifyHandler(backend),))
+        self.bound_port = self._server.add_insecure_port(listen_addr)
+        self._server.start()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.bound_port}"
+
+    def stop(self) -> None:
+        self._server.stop(grace=None)
+
+
+class RemoteVerifier(Verifier):
+    """Verifier seam implementation that defers to a sidecar.
+
+    Fail-closed: transport errors reject the whole batch (a vertex whose
+    signature cannot be checked must not enter the DAG — SURVEY.md D10's
+    fix requires signatures before any state change).
+    """
+
+    def __init__(self, address: str, *, timeout: float = 30.0):
+        self._channel = grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._timeout = timeout
+        self._lock = threading.Lock()
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        if not vertices:
+            return []
+        payload = _encode_batch(vertices)
+        try:
+            with self._lock:
+                mask = self._call(payload, timeout=self._timeout)
+        except grpc.RpcError:
+            return [False] * len(vertices)
+        if len(mask) != len(vertices):
+            return [False] * len(vertices)
+        return [b == 1 for b in mask]
+
+    def close(self) -> None:
+        self._channel.close()

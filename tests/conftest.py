@@ -8,9 +8,19 @@ path via ``__graft_entry__.dryrun_multichip``.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the axon TPU harness presets JAX_PLATFORMS=axon
+# and its sitecustomize both registers a PJRT plugin at interpreter start
+# (before this conftest) and calls jax.config.update("jax_platforms",
+# "axon,cpu"), which overrides the env var. Undo both so the suite runs on
+# the virtual 8-device CPU mesh regardless of launch environment.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (sitecustomize may have imported it already)
+
+jax.config.update("jax_platforms", "cpu")

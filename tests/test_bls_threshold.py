@@ -1,0 +1,133 @@
+"""BLS12-381 host implementation + (f+1)-of-n threshold coin.
+
+The coin properties under test are the four the reference names
+(``process/process.go:386-387``): agreement, termination (readiness once
+f+1 shares arrive), unpredictability (below-threshold reveals nothing
+usable), and fairness (leader depends on the wave).
+"""
+
+import itertools
+
+import pytest
+
+from dag_rider_tpu.consensus.coin import ThresholdCoin
+from dag_rider_tpu.crypto import bls12381 as bls
+from dag_rider_tpu.crypto import threshold as th
+
+
+# --- curve / pairing sanity -----------------------------------------------
+
+
+def test_generators_and_orders():
+    assert bls.g1_on_curve(bls.G1_GEN)
+    assert bls.g2_on_curve(bls.G2_GEN)
+    assert bls.g1_mul(bls.R, bls.G1_GEN) is None
+    assert bls.g2_mul(bls.R, bls.G2_GEN) is None
+
+
+def test_pairing_bilinearity():
+    e = bls.pairing(bls.G1_GEN, bls.G2_GEN)
+    assert e != bls.FP12_ONE
+    assert bls.pairing(bls.g1_mul(5), bls.g2_mul(3)) == bls.fp12_pow(e, 15)
+    # e(P, Q)^r == 1 (image has order r)
+    assert bls.fp12_pow(e, bls.R) == bls.FP12_ONE
+
+
+def test_sign_verify_roundtrip():
+    sk = 0xDEADBEEF12345678
+    pk = bls.pk_of(sk)
+    sig = bls.sign(sk, b"message")
+    assert bls.verify(pk, b"message", sig)
+    assert not bls.verify(pk, b"other", sig)
+    assert not bls.verify(pk, b"message", b"\x00" * 48)
+    assert not bls.verify(bls.pk_of(sk + 1), b"message", sig)
+
+
+def test_g1_compress_roundtrip():
+    for k in (1, 2, 12345, bls.R - 1):
+        p = bls.g1_mul(k)
+        assert bls.g1_decompress(bls.g1_compress(p)) == p
+    assert bls.g1_decompress(b"\x01" * 48) is None  # no compressed flag
+
+
+def test_hash_to_g1_in_subgroup():
+    p = bls.hash_to_g1(b"tag")
+    assert bls.g1_on_curve(p)
+    assert bls.g1_mul(bls.R, p) is None  # r-torsion after cofactor clearing
+    assert bls.hash_to_g1(b"tag") == p  # deterministic
+    assert bls.hash_to_g1(b"tag2") != p
+
+
+# --- threshold scheme ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return th.ThresholdKeys.generate(4, 2)
+
+
+def test_share_subset_agreement(keys):
+    """Any f+1 subset combines to the same group signature (agreement)."""
+    wave = 3
+    shares = {i: th.sign_share(keys.share_sks[i], wave) for i in range(4)}
+    sigmas = set()
+    for combo in itertools.combinations(range(4), 2):
+        sigma = th.aggregate({i: shares[i] for i in combo}, 2)
+        assert sigma is not None
+        assert th.verify_group(keys.group_pk, wave, sigma)
+        sigmas.add(sigma)
+    assert len(sigmas) == 1
+
+
+def test_share_verification(keys):
+    wave = 9
+    sh = th.sign_share(keys.share_sks[1], wave)
+    assert th.verify_share(keys.share_pks[1], wave, sh)
+    assert not th.verify_share(keys.share_pks[2], wave, sh)
+    assert not th.verify_share(keys.share_pks[1], wave + 1, sh)
+
+
+def test_coin_ready_and_agreement(keys):
+    wave = 5
+    coins = [ThresholdCoin(keys, i, 4) for i in range(4)]
+    shares = {i: coins[i].my_share(wave) for i in range(4)}
+    leaders = set()
+    for combo in itertools.combinations(range(4), 2):
+        c = ThresholdCoin(keys, 0, 4)
+        assert not c.ready(wave)
+        for i in combo:
+            c.observe_share(wave, i, shares[i])
+        assert c.ready(wave)
+        leaders.add(c.choose_leader(wave))
+    assert len(leaders) == 1
+    assert 0 <= leaders.pop() < 4
+
+
+def test_coin_byzantine_share_filtered(keys):
+    """A decodable-but-forged share must not corrupt or stall the coin."""
+    wave = 6
+    good = {i: th.sign_share(keys.share_sks[i], wave) for i in range(4)}
+    honest_sigma = th.aggregate({0: good[0], 1: good[1]}, 2)
+    c = ThresholdCoin(keys, 0, 4)
+    # forged share: a valid G1 point that is NOT a share signature; sorted
+    # first so the initial combination includes it and fails group verify.
+    forged = bls.g1_compress(bls.g1_mul(42))
+    c.observe_share(wave, 0, forged)
+    c.observe_share(wave, 1, good[1])
+    assert not c.ready(wave)  # only 1 honest share after filtering
+    c.observe_share(wave, 2, good[2])
+    assert c.ready(wave)
+    assert c.choose_leader(wave) == th.leader_from_sigma(honest_sigma, 4)
+
+
+def test_coin_wave_dependence(keys):
+    """Different waves give (generally) different leaders — fairness smoke
+    check: over 8 waves at n=4 at least two distinct leaders appear."""
+    leaders = set()
+    for wave in range(1, 9):
+        shares = {
+            i: th.sign_share(keys.share_sks[i], wave) for i in range(2)
+        }
+        sigma = th.aggregate(shares, 2)
+        leaders.add(th.leader_from_sigma(sigma, 4))
+    assert len(leaders) >= 2

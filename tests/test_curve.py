@@ -1,0 +1,134 @@
+"""Device curve ops (ops/curve.py) vs the host RFC 8032 oracle.
+
+Every device primitive must agree with crypto/ed25519.py exactly — this is
+what makes the CPU and TPU Verifier accept masks byte-identical
+(BASELINE.json north star).
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dag_rider_tpu.crypto import ed25519 as H
+from dag_rider_tpu.ops import curve as C
+from dag_rider_tpu.ops import field as F
+from dag_rider_tpu.verifier.tpu import bytes_to_limbs_batch, scalar_to_nibbles
+
+P = F.P_INT
+
+
+def to_limb_point(pt):
+    """Host extended point -> batched limb point (batch 1, affine Z=1)."""
+    X, Y, Z, _ = pt
+    zi = pow(Z, P - 2, P)
+    x, y = X * zi % P, Y * zi % P
+    return tuple(
+        jnp.asarray(F.to_limbs(v)[None]) for v in (x, y, 1, x * y % P)
+    )
+
+
+def affine(limb_pt, i=0):
+    X, Y, Z, _ = (
+        F.from_limbs(np.asarray(F.canonical(c))[i]) for c in limb_pt
+    )
+    zi = pow(Z, P - 2, P)
+    return (X * zi % P, Y * zi % P)
+
+
+def host_affine(pt):
+    X, Y, Z, _ = pt
+    zi = pow(Z, P - 2, P)
+    return (X * zi % P, Y * zi % P)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0xC0DE)
+
+
+def test_padd_pdouble_match_host(rng):
+    k1, k2 = rng.randrange(1, H.L), rng.randrange(1, H.L)
+    p_h, q_h = H.scalar_mult(k1, H.B), H.scalar_mult(k2, H.B)
+    p_l, q_l = to_limb_point(p_h), to_limb_point(q_h)
+    assert affine(jax.jit(C.padd)(p_l, q_l)) == host_affine(
+        H.point_add(p_h, q_h)
+    )
+    assert affine(jax.jit(C.pdouble)(p_l)) == host_affine(H.point_double(p_h))
+
+
+def test_identity_chains():
+    """Long double/add chains on the neutral element stay the neutral
+    element (the degenerate reps here exposed the col-43 carry bug)."""
+    fn = jax.jit(
+        lambda a: C.padd(
+            C.pdouble(C.pdouble(C.pdouble(C.pdouble(a)))), C.identity((1,))
+        )
+    )
+    acc = C.identity((1,))
+    for _ in range(16):
+        acc = fn(acc)
+    x, y = affine(acc)
+    assert (x, y) == (0, 1)
+
+
+def test_scalar_mul_var(rng):
+    ks = [0, 1, 2, 15, 16, 255, rng.randrange(H.L), H.L - 1, 2**252]
+    b_l = tuple(
+        jnp.repeat(c, len(ks), axis=0) for c in to_limb_point(H.B)
+    )
+    nib = jnp.asarray(np.stack([scalar_to_nibbles(k) for k in ks]))
+    got = jax.jit(C.scalar_mul_var)(nib, b_l)
+    for i, k in enumerate(ks):
+        want = (0, 1) if k == 0 else host_affine(H.scalar_mult(k, H.B))
+        assert affine(got, i) == want, f"k={k}"
+
+
+def test_scalar_mul_base(rng):
+    ks = [0, 1, rng.randrange(H.L), H.L - 1]
+    nib = jnp.asarray(np.stack([scalar_to_nibbles(k) for k in ks]))
+    got = jax.jit(C.scalar_mul_base)(nib)
+    for i, k in enumerate(ks):
+        want = (0, 1) if k == 0 else host_affine(H.scalar_mult(k, H.B))
+        assert affine(got, i) == want, f"k={k}"
+
+
+def test_decompress_matches_host(rng):
+    """Valid points, invalid (non-square) encodings, and the x=0/sign=1
+    arm must all match host point_decompress."""
+    encs = []
+    for _ in range(6):
+        k = rng.randrange(1, H.L)
+        encs.append(H.point_compress(H.scalar_mult(k, H.B)))
+    encs.append(int.to_bytes(2, 32, "little"))  # y=2: not on curve
+    encs.append(int.to_bytes(1 | (1 << 255), 32, "little"))  # x=0, sign=1
+    encs.append(int.to_bytes(1, 32, "little"))  # identity (x=0, sign=0)
+
+    raw = np.zeros((len(encs), 32), dtype=np.uint8)
+    signs = np.zeros(len(encs), dtype=np.int32)
+    for i, e in enumerate(encs):
+        buf = bytearray(e)
+        signs[i] = buf[31] >> 7
+        buf[31] &= 0x7F
+        raw[i] = np.frombuffer(bytes(buf), dtype=np.uint8)
+    y = jnp.asarray(bytes_to_limbs_batch(raw))
+    pt, valid = jax.jit(C.decompress)(y, jnp.asarray(signs))
+    for i, e in enumerate(encs):
+        host_pt = H.point_decompress(e)
+        assert bool(np.asarray(valid)[i]) == (host_pt is not None), f"enc {i}"
+        if host_pt is not None:
+            assert affine(pt, i) == host_affine(host_pt), f"enc {i}"
+
+
+def test_points_equal():
+    p = to_limb_point(H.scalar_mult(7, H.B))
+    q = to_limb_point(H.scalar_mult(7, H.B))
+    r = to_limb_point(H.scalar_mult(8, H.B))
+    assert bool(np.asarray(C.points_equal(p, q))[0])
+    assert not bool(np.asarray(C.points_equal(p, r))[0])
+    # projective scaling: 2*(X,Y,Z,T) is the same point
+    two = jnp.asarray(F.to_limbs(2)[None])
+    scaled = tuple(F.mul(c, two) for c in p)
+    assert bool(np.asarray(C.points_equal(p, scaled))[0])

@@ -1,0 +1,131 @@
+"""Limb-field arithmetic (ops/field.py) vs Python bignum oracle.
+
+The TPU verifier's correctness reduces to this field layer: every op must
+be exact mod p for all reduced representations, including the signed-limb
+and near-boundary cases that only arise deep inside point-op chains.
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dag_rider_tpu.ops import field as F
+
+P = F.P_INT
+
+
+def limbs(x):
+    return jnp.asarray(F.to_limbs(x)[None])
+
+
+def value(arr, i=0):
+    return F.from_limbs(np.asarray(F.canonical(arr))[i])
+
+
+EDGE = [0, 1, 2, 19, P - 1, P - 2, P - 19, 2**252, 2**255 - 20, (P + 1) // 2]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0xDA6)
+
+
+def batch_of(values):
+    return jnp.asarray(np.stack([F.to_limbs(v) for v in values]))
+
+
+def test_add_sub_mul_random(rng):
+    xs = [rng.randrange(P) for _ in range(32)] + EDGE
+    ys = [rng.randrange(P) for _ in range(32)] + list(reversed(EDGE))
+    A, B = batch_of(xs), batch_of(ys)
+    add = jax.jit(F.add)(A, B)
+    sub = jax.jit(F.sub)(A, B)
+    mul = jax.jit(F.mul)(A, B)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert value(add, i) == (x + y) % P
+        assert value(sub, i) == (x - y) % P
+        assert value(mul, i) == (x * y) % P
+
+
+def test_mul_extreme_limb_patterns():
+    """Representations with saturated limbs (the pattern that exposed the
+    dropped col-43 carry: every limb near 2^12, top limb at 2^12)."""
+    patterns = [
+        np.full(F.LIMBS, 4095, dtype=np.int32),
+        np.full(F.LIMBS, -4095, dtype=np.int32),
+        np.array([16383] + [8191] * 20 + [8191], dtype=np.int32),
+        np.array([-16383] + [-8191] * 21, dtype=np.int32),
+        np.array([2560] + [4095] * 18 + [-1, 0, 4096], dtype=np.int32),
+    ]
+    A = jnp.asarray(np.stack(patterns))
+    got = jax.jit(F.mul)(A, A)
+    for i, pat in enumerate(patterns):
+        want = pow(F.from_limbs(pat) % P, 2, P)
+        assert value(got, i) == want, f"pattern {i}"
+
+
+def test_invariant_preserved_deep_chain(rng):
+    """|limb0| < 2^14 and |limb_i| < 2^13 must survive arbitrary op chains
+    (mul inputs assume it; violation silently overflows int32)."""
+    x = rng.randrange(P)
+    y = rng.randrange(P)
+    A, B = limbs(x), limbs(y)
+    vx = x
+    for i in range(40):
+        A = F.mul(F.sub(A, B), F.add(A, B))
+        vx = ((vx - y) % P) * ((vx + y) % P) % P
+        raw = np.asarray(A)[0]
+        assert abs(int(raw[0])) < 2**14, f"limb0 blown at step {i}"
+        assert (np.abs(raw[1:]) < 2**13).all(), f"limb blown at step {i}"
+    assert value(A) == vx
+
+
+def test_inversion_and_pow(rng):
+    xs = [rng.randrange(1, P) for _ in range(8)] + [1, P - 1, 2]
+    A = batch_of(xs)
+    inv = jax.jit(F.invert)(A)
+    p22 = jax.jit(F.pow22523)(A)
+    for i, x in enumerate(xs):
+        assert value(inv, i) == pow(x, P - 2, P)
+        assert value(p22, i) == pow(x, 2**252 - 3, P)
+    assert value(jax.jit(F.invert)(limbs(0))) == 0
+
+
+def test_canonical_uniqueness():
+    """Different representations of the same residue must canonicalize to
+    identical limbs — eq/is_zero depend on it."""
+    reps = [
+        F.to_limbs(19),
+        (F.to_limbs(19 + 0) + F.P_LIMBS).astype(np.int32),  # 19 + p
+        np.array([19 - 4096, 1] + [0] * 20, dtype=np.int32),  # borrow form
+    ]
+    outs = [np.asarray(F.canonical(jnp.asarray(r[None])))[0] for r in reps]
+    for o in outs[1:]:
+        assert np.array_equal(o, outs[0])
+    # values in [p, 2^255) reduce
+    assert value(jnp.asarray(F.to_limbs(P)[None])) == 0
+    assert value(jnp.asarray(F.to_limbs(P + 5)[None])) == 5
+    assert value(jnp.asarray(F.to_limbs(2**255 - 1)[None])) == 18
+
+
+def test_predicates(rng):
+    x = rng.randrange(1, P)
+    A = limbs(x)
+    assert bool(np.asarray(F.is_zero(F.sub(A, A)))[0])
+    assert not bool(np.asarray(F.is_zero(A))[0])
+    assert bool(np.asarray(F.eq(A, A))[0])
+    assert int(np.asarray(F.parity(A))[0]) == x & 1
+    got = np.asarray(F.select(jnp.asarray([True]), A, limbs(1)))
+    assert np.array_equal(got, np.asarray(A))
+
+
+def test_mul_small(rng):
+    xs = [rng.randrange(P) for _ in range(4)] + EDGE[:4]
+    A = batch_of(xs)
+    for k in (0, 1, 2, 19, 4095):
+        got = jax.jit(F.mul_small, static_argnums=1)(A, k)
+        for i, x in enumerate(xs):
+            assert value(got, i) == x * k % P

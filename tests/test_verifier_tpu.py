@@ -1,0 +1,138 @@
+"""TPUVerifier vs CPUVerifier — byte-identical accept masks and commit order.
+
+The north star (BASELINE.json): "CPU-vs-TPU commit order byte-identical".
+The consensus state machine is a deterministic function of the accept masks
+and the delivery schedule, so mask equality on every batch (including
+adversarial ones) implies commit-order equality; the end-to-end sim test
+checks the full pipeline anyway.
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core.types import Block, Vertex, VertexID
+from dag_rider_tpu.crypto import ed25519
+from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+from dag_rider_tpu.verifier.cpu import CPUVerifier
+from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return KeyRegistry.generate(8)
+
+
+@pytest.fixture(scope="module")
+def signed_vertices(keys):
+    reg, seeds = keys
+    signers = [VertexSigner(s) for s in seeds]
+    out = []
+    for i in range(8):
+        v = Vertex(
+            id=VertexID(3, i),
+            block=Block((f"tx-{i}".encode(),)),
+            strong_edges=(VertexID(2, 0), VertexID(2, 1), VertexID(2, 2)),
+        )
+        out.append(signers[i].sign_vertex(v))
+    return out
+
+
+def corruptions(vs):
+    rng = random.Random(99)
+    bad = [
+        dataclasses.replace(vs[0], signature=b"\x00" * 64),
+        dataclasses.replace(vs[1], signature=vs[2].signature),
+        dataclasses.replace(vs[3], block=Block((b"tampered",))),
+        dataclasses.replace(vs[6], signature=None),
+    ]
+    # s >= L (malleability)
+    s_big = int.to_bytes(
+        int.from_bytes(vs[4].signature[32:], "little") + ed25519.L,
+        32,
+        "little",
+    )
+    bad.append(
+        dataclasses.replace(vs[4], signature=vs[4].signature[:32] + s_big)
+    )
+    # R.y >= p
+    ybad = int.to_bytes(2**255 - 10, 32, "little")
+    bad.append(
+        dataclasses.replace(vs[5], signature=ybad + vs[5].signature[32:])
+    )
+    # random bit flips across R, s
+    for i in range(6):
+        sig = bytearray(vs[i].signature)
+        sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+        bad.append(dataclasses.replace(vs[i], signature=bytes(sig)))
+    return bad
+
+
+def test_masks_byte_identical(keys, signed_vertices):
+    reg, _ = keys
+    batch = signed_vertices + corruptions(signed_vertices)
+    cpu = CPUVerifier(reg).verify_batch(batch)
+    tpu = TPUVerifier(reg).verify_batch(batch)
+    assert cpu == tpu
+    assert cpu[: len(signed_vertices)] == [True] * len(signed_vertices)
+    assert not any(cpu[len(signed_vertices) :])
+
+
+def test_empty_and_padding(keys, signed_vertices):
+    reg, _ = keys
+    tpu = TPUVerifier(reg)
+    assert tpu.verify_batch([]) == []
+    # batch sizes straddling the bucket boundary behave identically
+    assert tpu.verify_batch(signed_vertices[:1]) == [True]
+    assert tpu.verify_batch(signed_vertices[:3]) == [True] * 3
+
+
+def test_out_of_range_source(keys, signed_vertices):
+    reg, _ = keys
+    v = dataclasses.replace(
+        signed_vertices[0], id=VertexID(3, 999)
+    )
+    assert TPUVerifier(reg).verify_batch([v]) == [False]
+    assert CPUVerifier(reg).verify_batch([v]) == [False]
+
+
+def test_invalid_registry_key():
+    reg, seeds = KeyRegistry.generate(4)
+    # replace key 2 with a non-decompressible encoding (y = 2 not on curve)
+    pubs = list(reg.public_keys)
+    pubs[2] = int.to_bytes(2, 32, "little")
+    broken = KeyRegistry(tuple(pubs))
+    signer = VertexSigner(seeds[2])
+    v = signer.sign_vertex(
+        Vertex(id=VertexID(1, 2), strong_edges=(VertexID(0, 0),))
+    )
+    assert TPUVerifier(broken).verify_batch([v]) == [False]
+    assert CPUVerifier(broken).verify_batch([v]) == [False]
+
+
+def test_commit_order_byte_identical_cpu_vs_tpu():
+    """4-node simulation run twice — once with the CPU verifier, once with
+    the TPU verifier — must deliver the identical vertex sequence on every
+    node (the north-star equivalence, end to end)."""
+    logs = {}
+    for backend in ("cpu", "tpu"):
+        cfg = Config(n=4, signature_scheme="ed25519")
+        reg, seeds = KeyRegistry.generate(cfg.n)
+        make = CPUVerifier if backend == "cpu" else TPUVerifier
+        sim = Simulation(
+            cfg,
+            verifier_factory=lambda i: make(reg),
+            signer_factory=lambda i: VertexSigner(seeds[i]),
+        )
+        sim.submit_blocks(3)
+        sim.run(max_messages=4000)
+        sim.check_agreement()
+        logs[backend] = [
+            [(vid.round, vid.source) for vid in p.delivered_log]
+            for p in sim.processes
+        ]
+        assert any(logs[backend]), "no deliveries happened"
+    assert logs["cpu"] == logs["tpu"]
