@@ -2,30 +2,47 @@
 
 Prints ONE JSON line:
   {"metric": "vertex_sigs_per_sec", "value": N, "unit": "sigs/s",
-   "vs_baseline": N / 50000}
+   "vs_baseline": N / 50000, "backend": ..., "wave_commit_p50_ms": ...}
 
 BASELINE.json north star: >= 50,000 vertex-signatures verified/sec on a
 single TPU v5e chip at committee size n=256. The measured quantity is the
 steady-state end-to-end Verifier throughput: host prep (SHA-512 challenge
 scalars, byte parsing) + one device dispatch per whole-round batch —
 exactly what the consensus hot path pays per DAG round.
+``wave_commit_p50_ms`` is the per-wave device pipeline latency: 4 round
+verify dispatches + the wave-commit quorum kernel + host total ordering.
+
+Robustness (round-1 postmortem: the TPU backend raised UNAVAILABLE during
+init and the whole bench died rc=1 with no data): the measurement runs in a
+time-boxed subprocess; if the primary backend fails to initialize or hangs,
+the bench re-runs on the CPU backend and reports that number with the
+backend recorded — one JSON line and rc=0, always.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+BASELINE = 50_000.0
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def build_batch(n: int, rounds: int):
+# ----------------------------------------------------------------------
+# Inner: the actual measurement (runs in a subprocess, one backend)
+# ----------------------------------------------------------------------
+
+def _build_batches(n: int, rounds: int):
     from dag_rider_tpu.core.types import Block, Vertex, VertexID
     from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
     from dag_rider_tpu.verifier.tpu import TPUVerifier
 
     reg, seeds = KeyRegistry.generate(n)
     signers = [VertexSigner(s) for s in seeds]
+    quorum = 2 * ((n - 1) // 3) + 1
     batches = []
     for r in range(rounds):
         vs = []
@@ -34,7 +51,7 @@ def build_batch(n: int, rounds: int):
                 id=VertexID(r + 1, i),
                 block=Block((f"r{r}-tx-{i}".encode() * 2,)),
                 strong_edges=tuple(
-                    VertexID(r, s) for s in range(min(n, 2 * ((n - 1) // 3) + 1))
+                    VertexID(r, s) for s in range(min(n, quorum))
                 ),
             )
             vs.append(signers[i].sign_vertex(v))
@@ -42,15 +59,37 @@ def build_batch(n: int, rounds: int):
     return TPUVerifier(reg), batches
 
 
-def main() -> None:
-    n = 256
-    warm_rounds = 2
-    timed_rounds = 8
-    verifier, batches = build_batch(n, warm_rounds + timed_rounds)
+def _inner() -> None:
+    import jax
 
+    # The axon sitecustomize force-sets jax_platforms at interpreter start,
+    # overriding the JAX_PLATFORMS env var (same issue tests/conftest.py
+    # works around). Re-assert the platform this attempt was asked to use.
+    want = os.environ.get("DAGRIDER_BENCH_PLATFORM")
+    if want:
+        jax.config.update("jax_platforms", want)
+
+    from dag_rider_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(_REPO, ".jax_cache"))
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    backend = jax.default_backend()
+    init_s = time.perf_counter() - t0
+
+    n = int(os.environ.get("DAGRIDER_BENCH_N", "256"))
+    warm_rounds = 2
+    timed_rounds = int(os.environ.get("DAGRIDER_BENCH_ROUNDS", "8"))
+    verifier, batches = _build_batches(n, warm_rounds + timed_rounds)
+
+    t0 = time.perf_counter()
     for b in batches[:warm_rounds]:  # compile + warm
         mask = verifier.verify_batch(b)
         assert all(mask), "warmup batch failed to verify"
+    compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     total = 0
@@ -59,19 +98,132 @@ def main() -> None:
         total += len(mask)
         assert all(mask)
     dt = time.perf_counter() - t0
-
     sigs_per_sec = total / dt
-    baseline = 50_000.0
+
+    # -- wave-commit pipeline latency: one wave = 4 round verify
+    # dispatches + the quorum kernel + host total ordering over the wave's
+    # dense DAG (the host twin the Process runs at commit time).
+    from dag_rider_tpu.ops import dag_kernels
+
+    rng = np.random.default_rng(7)
+    strong_wave = jnp.asarray(
+        rng.random((3, n, n)) < min(1.0, (2 * ((n - 1) // 3) + 1.5) / n)
+    )
+    exists_r4 = jnp.ones(n, dtype=bool)
+    leader = jnp.int32(1)
+    commit_fn = jax.jit(
+        lambda s, e, l: dag_kernels.wave_commit_votes(
+            s, e, l, quorum=2 * ((n - 1) // 3) + 1
+        )
+    )
+    jax.block_until_ready(commit_fn(strong_wave, exists_r4, leader))  # warm
+
+    strong_np = np.asarray(strong_wave)
+    wave_ms = []
+    n_waves = max(4, timed_rounds // 2)
+    for w in range(n_waves):
+        t0 = time.perf_counter()
+        for k in range(4):
+            verifier.verify_batch(batches[(w * 4 + k) % len(batches)])
+        commit, votes = commit_fn(strong_wave, exists_r4, leader)
+        jax.block_until_ready((commit, votes))
+        # host ordering twin: causal closure over the wave's rounds
+        reach = np.eye(n, dtype=bool)
+        for r in range(3):
+            reach = (reach.astype(np.int32) @ strong_np[r].astype(np.int32)) > 0
+        wave_ms.append(1e3 * (time.perf_counter() - t0))
+    wave_ms.sort()
+    p50 = wave_ms[len(wave_ms) // 2]
+
     print(
         json.dumps(
             {
                 "metric": "vertex_sigs_per_sec",
                 "value": round(sigs_per_sec, 1),
                 "unit": "sigs/s",
-                "vs_baseline": round(sigs_per_sec / baseline, 3),
+                "vs_baseline": round(sigs_per_sec / BASELINE, 3),
+                "backend": backend,
+                "n": n,
+                "wave_commit_p50_ms": round(p50, 2),
+                "compile_s": round(compile_s, 1),
+                "backend_init_s": round(init_s, 1),
             }
         )
     )
+
+
+# ----------------------------------------------------------------------
+# Outer: backend attempts with timeouts; always emits JSON, rc=0
+# ----------------------------------------------------------------------
+
+def _attempt(env: dict, timeout_s: float):
+    """Run the inner bench in a subprocess; return (json_line | None, tail)."""
+    env = dict(env)
+    env["DAGRIDER_BENCH_INNER"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        out = (e.output or "") if isinstance(e.output, str) else ""
+        return None, f"timeout after {timeout_s}s; partial output: {out[-500:]}"
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or proc.stdout or "")[-800:]
+    return None, f"rc={proc.returncode}; {tail}"
+
+
+def main() -> None:
+    if os.environ.get("DAGRIDER_BENCH_INNER"):
+        _inner()
+        return
+
+    errors = []
+    # Budgets: worst case (primary hang + CPU fallback) must stay under the
+    # ~9.5-minute driver window with headroom; the CPU fallback hits the
+    # persistent compile cache, so 150s is generous.
+    primary_timeout = float(os.environ.get("DAGRIDER_BENCH_TPU_TIMEOUT", "270"))
+    cpu_timeout = float(os.environ.get("DAGRIDER_BENCH_CPU_TIMEOUT", "150"))
+
+    # Attempt 1: whatever backend the environment selects (TPU under the
+    # driver). Time-boxed because axon backend init can hang for minutes.
+    result, err = _attempt(os.environ, primary_timeout)
+    if result is None:
+        errors.append(f"primary backend: {err}")
+        # Attempt 2: forced-CPU fallback so a perf number always exists.
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DAGRIDER_BENCH_PLATFORM"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env.setdefault("DAGRIDER_BENCH_N", "64")  # CPU: smaller committee
+        env.setdefault("DAGRIDER_BENCH_ROUNDS", "4")
+        result, err = _attempt(env, cpu_timeout)
+        if result is None:
+            errors.append(f"cpu fallback: {err}")
+
+    if result is None:
+        result = {
+            "metric": "vertex_sigs_per_sec",
+            "value": 0.0,
+            "unit": "sigs/s",
+            "vs_baseline": 0.0,
+            "backend": "none",
+            "error": " || ".join(errors)[-900:],
+        }
+    elif errors:
+        result["fallback_reason"] = " || ".join(errors)[-400:]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

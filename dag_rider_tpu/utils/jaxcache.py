@@ -1,0 +1,27 @@
+"""Shared persistent XLA compilation-cache setup.
+
+The limb-field/curve programs cost ~20-40s each to compile; every entry
+point (test suite, bench, driver dryrun) wants the same repo-local cache so
+repeated runs skip XLA entirely. One helper, called from all of them, so
+the config knobs cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+
+def enable_persistent_cache(path: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    ``<repo>/.jax_cache``) and cache every entry regardless of size or
+    compile time."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path or _DEFAULT)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

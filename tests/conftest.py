@@ -24,3 +24,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (sitecustomize may have imported it already)
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the limb-field/curve programs cost ~20s+
+# each to compile on CPU; caching them under the repo makes repeated suite
+# runs (and the driver's) skip the XLA compile entirely.
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from dag_rider_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
