@@ -91,6 +91,12 @@ def _inner() -> None:
         assert all(mask), "warmup batch failed to verify"
     compile_s = time.perf_counter() - t0
 
+    # Optional profiler capture (SURVEY §5): set DAGRIDER_PROFILE_DIR to
+    # write a jax.profiler trace of the timed loop (TraceAnnotations inside
+    # TPUVerifier.verify_batch label host-prep vs device-dispatch).
+    profile_dir = os.environ.get("DAGRIDER_PROFILE_DIR")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     total = 0
     for b in batches[warm_rounds:]:
@@ -98,6 +104,8 @@ def _inner() -> None:
         total += len(mask)
         assert all(mask)
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
     sigs_per_sec = total / dt
 
     # -- wave-commit pipeline latency: one wave = 4 round verify

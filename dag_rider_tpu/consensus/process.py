@@ -146,6 +146,11 @@ class Process:
         influence any state.
         """
         self.metrics.inc("msgs_received")
+        if msg.kind != "val" or msg.vertex is None:
+            # RBC control traffic (echo/ready/fetch) is consumed by the
+            # transport/rbc.py stage; a Process only eats vertex payloads.
+            self.metrics.inc("msgs_ignored_kind")
+            return
         v = msg.vertex
         if (
             v.id.round != msg.round
@@ -387,19 +392,21 @@ class Process:
         # Retroactive leader chain (process.go:341-350): walk back through
         # undecided waves, committing every prior leader the current one
         # covers by a strong path.
-        leaders: Stack[Vertex] = Stack()
-        leaders.push(leader)
-        cur = leader
-        for w in range(wave - 1, self.decided_wave, -1):
-            prior = self._wave_leader(w)
-            if prior is not None and self.dag.path(
-                cur.id, prior.id, strong_only=True
-            ):
-                leaders.push(prior)
-                cur = prior
-        self.decided_wave = wave
-        self.metrics.inc("waves_decided")
-        self._order_vertices(leaders)
+        with Timer() as t:
+            leaders: Stack[Vertex] = Stack()
+            leaders.push(leader)
+            cur = leader
+            for w in range(wave - 1, self.decided_wave, -1):
+                prior = self._wave_leader(w)
+                if prior is not None and self.dag.path(
+                    cur.id, prior.id, strong_only=True
+                ):
+                    leaders.push(prior)
+                    cur = prior
+            self.decided_wave = wave
+            self.metrics.inc("waves_decided")
+            self._order_vertices(leaders)
+        self.metrics.observe_wave_commit(t.seconds)
 
     def _wave_leader(self, wave: int) -> Optional[Vertex]:
         """Leader lookup (reference ``getWaveVertexLeader``,

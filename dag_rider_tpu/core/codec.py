@@ -76,16 +76,56 @@ def decode_vertex(data: bytes, offset: int = 0) -> Tuple[Vertex, int]:
     return v, offset
 
 
+_KINDS = ("val", "echo", "ready", "fetch")
+
+
 def encode_message(msg: BroadcastMessage) -> bytes:
-    body = encode_vertex(msg.vertex)
-    return struct.pack("<III", len(body) + 8, msg.round, msg.sender) + body
+    """Message layout: round, sender, kind byte, origin (int32, -1 = none),
+    digest (int32 length prefix, -1 = none), vertex-present flag + vertex."""
+    out = [
+        struct.pack("<IIB", msg.round, msg.sender, _KINDS.index(msg.kind)),
+        struct.pack("<i", -1 if msg.origin is None else msg.origin),
+    ]
+    if msg.digest is None:
+        out.append(struct.pack("<i", -1))
+    else:
+        out.append(struct.pack("<i", len(msg.digest)))
+        out.append(msg.digest)
+    if msg.vertex is None:
+        out.append(b"\x00")
+    else:
+        out.append(b"\x01")
+        out.append(encode_vertex(msg.vertex))
+    return b"".join(out)
 
 
 def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]:
-    total, rnd, sender = struct.unpack_from("<III", data, offset)
-    offset += 12
-    v, offset = decode_vertex(data, offset)
-    return BroadcastMessage(vertex=v, round=rnd, sender=sender), offset
+    rnd, sender, kind_code = struct.unpack_from("<IIB", data, offset)
+    offset += 9
+    (origin,) = struct.unpack_from("<i", data, offset)
+    offset += 4
+    (dlen,) = struct.unpack_from("<i", data, offset)
+    offset += 4
+    digest = None
+    if dlen >= 0:
+        digest = data[offset : offset + dlen]
+        offset += dlen
+    has_vertex = data[offset]
+    offset += 1
+    v = None
+    if has_vertex:
+        v, offset = decode_vertex(data, offset)
+    return (
+        BroadcastMessage(
+            vertex=v,
+            round=rnd,
+            sender=sender,
+            kind=_KINDS[kind_code],
+            origin=None if origin < 0 else origin,
+            digest=digest,
+        ),
+        offset,
+    )
 
 
 def frame(payload: bytes) -> bytes:

@@ -126,8 +126,18 @@ class BroadcastMessage:
 
     The reference *trusts* these stamps (D10, ``process.go:159-162``); here
     they are cross-checked against the signed vertex id on receipt.
+
+    ``kind`` extends the wire beyond the reference's single message type:
+    "val" is a vertex payload (the only kind a Process consumes); "echo" /
+    "ready" / "fetch" are the Bracha reliable-broadcast control messages of
+    :mod:`dag_rider_tpu.transport.rbc`, which carry ``origin`` (the source
+    index of the vertex being amplified) and ``digest`` instead of a
+    payload.
     """
 
-    vertex: Vertex
+    vertex: Optional[Vertex]
     round: int
     sender: int
+    kind: str = "val"
+    origin: Optional[int] = None
+    digest: Optional[bytes] = None

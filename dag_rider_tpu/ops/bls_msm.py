@@ -1,0 +1,205 @@
+"""BLS12-381 G1 multi-scalar multiplication on the accelerator.
+
+The device half of threshold-share aggregation
+(:func:`dag_rider_tpu.crypto.threshold.aggregate`): the combination
+sigma = sum_i lambda_i * sigma_i is a G1 MSM — the TPU-acceleration target
+BASELINE.json names for the n=256/1024 rungs (configs #4-5) and the
+riskiest item of the build plan (SURVEY.md §7). The pairing checks stay
+host-side (:mod:`dag_rider_tpu.crypto.bls12381`), exactly as ordering
+decisions do.
+
+Design, TPU-first rather than a CPU-algorithm port:
+
+- Field: :mod:`dag_rider_tpu.ops.field381` (signed 12-bit int32 limbs,
+  fold-matrix reduction — no widening multiply needed).
+- Group law: the **Renes-Costello-Batina complete addition formulas**
+  (eprint 2015/1060, Algorithm 7 specialized to a = 0, b3 = 3*4 = 12) in
+  homogeneous projective coordinates. Complete means *no* exceptional
+  cases: P == Q, P == -Q, and the identity (0:1:0) all flow through the
+  same 12M straight-line program — zero data-dependent control flow, no
+  device-side equality tests or inversions, which is exactly what XLA
+  wants. A Jacobian ladder with branch selects would cost less raw M but
+  serializes on canonical() equality checks; completeness is the right
+  trade on this hardware.
+- MSM shape: per-point 4-bit windowed scalar multiplication (radix-16
+  table of 0..15 multiples, 63 windows for the 255-bit scalar group order,
+  4 doublings + 1 table add per window) vmapped over the points, then a
+  pairwise tree reduction over the point axis. Pippenger bucket
+  accumulation needs data-dependent scatters — hostile to the compiler;
+  batched windows + tree sum keep every step dense and fused.
+
+Scalars are taken mod r (the G1 group order) on the host; points arrive as
+host affine tuples (already decompressed/validated by
+``bls12381.g1_decompress``) and return as one host affine tuple.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dag_rider_tpu.ops import field381 as F
+
+R_INT = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+P_INT = F.P_INT
+WINDOWS = 64  # 256-bit scalar capacity in 4-bit windows (r is 255 bits)
+
+Point = Tuple[jax.Array, jax.Array, jax.Array]  # homogeneous (X, Y, Z)
+
+
+def identity(shape=()) -> Point:
+    """The group identity (0 : 1 : 0)."""
+    zero = jnp.broadcast_to(jnp.asarray(F.ZERO), (*shape, F.LIMBS))
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), (*shape, F.LIMBS))
+    return (zero, one, zero)
+
+
+def padd(p: Point, q: Point) -> Point:
+    """Complete addition, RCB15 Algorithm 7 (a = 0, b3 = 12): 12M + 2m."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    t0 = F.mul(X1, X2)
+    t1 = F.mul(Y1, Y2)
+    t2 = F.mul(Z1, Z2)
+    t3 = F.mul(F.add(X1, Y1), F.add(X2, Y2))
+    t3 = F.sub(t3, F.add(t0, t1))
+    t4 = F.mul(F.add(Y1, Z1), F.add(Y2, Z2))
+    t4 = F.sub(t4, F.add(t1, t2))
+    x3 = F.mul(F.add(X1, Z1), F.add(X2, Z2))
+    y3 = F.sub(x3, F.add(t0, t2))
+    x3 = F.add(F.add(t0, t0), t0)  # 3 X1 X2
+    t2 = F.mul_small(t2, 12)  # b3 Z1 Z2
+    z3 = F.add(t1, t2)
+    t1 = F.sub(t1, t2)
+    y3 = F.mul_small(y3, 12)  # b3 (X1 Z2 + X2 Z1)
+    X3 = F.sub(F.mul(t3, t1), F.mul(t4, y3))
+    Y3 = F.add(F.mul(y3, x3), F.mul(t1, z3))
+    Z3 = F.add(F.mul(z3, t4), F.mul(x3, t3))
+    return (X3, Y3, Z3)
+
+
+def pdouble(p: Point) -> Point:
+    """Doubling via the complete formula (P + P is a valid input to it)."""
+    return padd(p, p)
+
+
+def pselect(cond: jax.Array, p: Point, q: Point) -> Point:
+    return tuple(F.select(cond, a, b) for a, b in zip(p, q))
+
+
+# ---------------------------------------------------------------------------
+# Windowed scalar multiplication + tree-sum MSM
+# ---------------------------------------------------------------------------
+
+
+def _gather_entry(table: Tuple[jax.Array, ...], idx: jax.Array) -> Point:
+    """table coords [..., 16, LIMBS]; idx int32[...] in [0, 16)."""
+    out = []
+    for coord in table:
+        g = jnp.take_along_axis(
+            coord, idx[..., None, None].astype(jnp.int32), axis=-2
+        )
+        out.append(g[..., 0, :])
+    return tuple(out)
+
+
+def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
+    """[k]P — 4-bit fixed windows, MSB first, batched over leading dims.
+
+    nibbles: int32[..., 64], little-endian. The window walk is a fori_loop
+    so the HLO stays one window long regardless of scalar size.
+    """
+    entries = [identity(nibbles.shape[:-1]), p]
+    for _ in range(14):
+        entries.append(padd(entries[-1], p))
+    table = tuple(
+        jnp.stack([e[c] for e in entries], axis=-2) for c in range(3)
+    )
+
+    def body(i, acc):
+        acc = pdouble(pdouble(pdouble(pdouble(acc))))
+        idx = jnp.take(nibbles, WINDOWS - 1 - i, axis=-1)
+        return padd(acc, _gather_entry(table, idx))
+
+    return jax.lax.fori_loop(0, WINDOWS, body, identity(nibbles.shape[:-1]))
+
+
+@jax.jit
+def msm_kernel(
+    nibbles: jax.Array, px: jax.Array, py: jax.Array, pz: jax.Array
+) -> Point:
+    """sum_i [k_i] P_i for a padded batch of T points.
+
+    nibbles: int32[T, 64]; px/py/pz: int32[T, 33]. Pad slots use scalar 0
+    (maps to the identity). Returns one projective point (X, Y, Z) [33].
+    """
+    acc = scalar_mul(nibbles, (px, py, pz))  # [T, 33] each — vmapped walk
+    # pairwise tree reduction over the point axis (T is a power of two)
+    t = px.shape[0]
+    while t > 1:
+        t //= 2
+        acc = padd(
+            tuple(c[:t] for c in acc), tuple(c[t : 2 * t] for c in acc)
+        )
+    return tuple(c[0] for c in acc)
+
+
+# ---------------------------------------------------------------------------
+# Host seam: threshold.aggregate(msm=...) plug
+# ---------------------------------------------------------------------------
+
+
+def _nibbles(k: int) -> np.ndarray:
+    out = np.zeros(WINDOWS, dtype=np.int32)
+    for i in range(WINDOWS):
+        out[i] = (k >> (4 * i)) & 0xF
+    return out
+
+
+def _pad(n: int) -> int:
+    t = 4
+    while t < n:
+        t *= 2
+    return t
+
+
+def msm(scalars: Sequence[int], points: Sequence[tuple]) -> Optional[tuple]:
+    """Device MSM over host affine points; the ``msm=`` backend of
+    :func:`dag_rider_tpu.crypto.threshold.aggregate`.
+
+    Args:
+        scalars: python ints (reduced mod r here).
+        points: affine (x, y) int tuples or None (identity), as produced by
+            ``bls12381.g1_decompress``.
+
+    Returns an affine (x, y) tuple, or None for the identity.
+    """
+    if len(scalars) != len(points):
+        raise ValueError("scalars/points length mismatch")
+    t = _pad(len(points))
+    nib = np.zeros((t, WINDOWS), dtype=np.int32)
+    px = np.zeros((t, F.LIMBS), dtype=np.int32)
+    py = np.zeros((t, F.LIMBS), dtype=np.int32)
+    pz = np.zeros((t, F.LIMBS), dtype=np.int32)
+    py[:] = F.ONE  # pad slots: identity (0 : 1 : 0) with scalar 0
+    for i, (k, pt) in enumerate(zip(scalars, points)):
+        if pt is None:
+            continue  # identity contributes nothing regardless of scalar
+        nib[i] = _nibbles(k % R_INT)
+        px[i] = F.to_limbs(pt[0])
+        py[i] = F.to_limbs(pt[1])
+        pz[i] = F.ONE
+    X, Y, Z = msm_kernel(
+        jnp.asarray(nib), jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)
+    )
+    xi = F.from_limbs(np.asarray(F.canonical(X)))
+    yi = F.from_limbs(np.asarray(F.canonical(Y)))
+    zi = F.from_limbs(np.asarray(F.canonical(Z)))
+    if zi == 0:
+        return None
+    z_inv = pow(zi, P_INT - 2, P_INT)
+    return (xi * z_inv % P_INT, yi * z_inv % P_INT)

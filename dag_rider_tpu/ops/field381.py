@@ -1,0 +1,240 @@
+"""GF(p) arithmetic for BLS12-381 in int32 limbs — the 381-bit field layer.
+
+Companion of :mod:`dag_rider_tpu.ops.field` (the 2^255-19 field under the
+Ed25519 verifier) for the BLS12-381 base field under the G1 MSM kernel
+(:mod:`dag_rider_tpu.ops.bls_msm` — BASELINE.json configs #4-5; the
+reference's coin TODO at ``process/process.go:388`` is what this
+ultimately serves).
+
+Same design stance as ``field.py`` (SURVEY.md §7 hard part (a): no widening
+64-bit multiply on the accelerator), adapted to a *generic* modulus:
+
+- **33 little-endian limbs of 12 bits in int32** (396 bits of headroom over
+  the 381-bit p). Limbs are signed; subtraction is limb-wise.
+- 2^255-19 folds its top limb with a scalar (19·2^9); an arbitrary p
+  cannot. Instead high product columns fold through a precomputed
+  **fold matrix**: row j holds the 32 strict limbs of 2^(12(j+32)) mod p,
+  so folding is one small integer matmul — still static-shape, gather-free.
+- "reduced" invariant (accepted/produced by every public op): |limb| <
+  2^12 + 2^7 across all 33 limbs. Schoolbook columns then stay below
+  33 * (2^12.07)^2 < 2^29.3 — comfortably inside int32.
+- carry propagation is parallel (all limbs at once, constant steps); the
+  carry out of limb 32 (weight 2^396) folds via the matrix row for
+  2^396 mod p. Exact sequential passes appear only in :func:`canonical`.
+
+Everything is shape-polymorphic over leading batch dims and jit/vmap safe.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+LIMB_BITS = 12
+LIMBS = 33  # 33 * 12 = 396 >= 381
+LIMB_MASK = (1 << LIMB_BITS) - 1
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+_NCOLS = 67  # 65 product columns (0..64) + 2 spill columns for carries
+
+
+def _strict_limbs(x: int, n: int) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value does not fit")
+    return out
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Host helper: python int in [0, 2^396) -> int32[33]."""
+    if not 0 <= x < 2**396:
+        raise ValueError("out of limb range")
+    return _strict_limbs(x, LIMBS)
+
+
+def from_limbs(limbs) -> int:
+    """Host helper: limb vector -> python int (signed limbs allowed)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    val = 0
+    for i in reversed(range(arr.shape[-1])):
+        val = (val << LIMB_BITS) + int(arr[..., i])
+    return val
+
+
+# Fold matrix: FOLD[j] = strict 32-limb decomposition of 2^(12(j+32)) mod p,
+# for j = 0 .. (_NCOLS - 32 - 1). Row 1 (= 2^396 mod p) doubles as the
+# top-limb fold inside the parallel carry step.
+FOLD = np.stack(
+    [
+        _strict_limbs(pow(2, LIMB_BITS * (j + 32), P_INT), 32)
+        for j in range(_NCOLS - 32)
+    ]
+).astype(np.int32)
+_FOLD_TOP = np.zeros(LIMBS, dtype=np.int32)
+_FOLD_TOP[:32] = FOLD[1]
+
+ZERO = np.zeros(LIMBS, dtype=np.int32)
+ONE = to_limbs(1)
+
+# p * 2^15 > any reduced-magnitude value (|value| < 2^12.1 * 2^384 <
+# 2^396.1 < p * 2^15 ~ 2^396.7), held as 32 strict limbs + a wide top limb.
+_BIG = P_INT << 15
+_BIG_P = np.zeros(LIMBS, dtype=np.int32)
+for _i in range(32):
+    _BIG_P[_i] = (_BIG >> (LIMB_BITS * _i)) & LIMB_MASK
+_BIG_P[32] = _BIG >> (LIMB_BITS * 32)  # < 2^13
+
+# k*p in strict limbs for the canonical conditional subtractions
+_KP = {k: to_limbs(k * P_INT) for k in (1, 2, 4, 8)}
+
+
+# --- carry propagation -----------------------------------------------------
+
+
+def _carry_step(x: jax.Array) -> jax.Array:
+    """One parallel carry step; the carry out of limb 32 (weight 2^396)
+    folds back through 2^396 mod p."""
+    c = x >> LIMB_BITS
+    low = x & LIMB_MASK
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+    )
+    return low + shifted + c[..., -1:] * jnp.asarray(_FOLD_TOP)
+
+
+def carry(x: jax.Array, steps: int = 2) -> jax.Array:
+    for _ in range(steps):
+        x = _carry_step(x)
+    return x
+
+
+# --- ring ops --------------------------------------------------------------
+
+
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return carry(a + b, steps=2)
+
+
+def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    return carry(a - b, steps=2)
+
+
+def neg(a: jax.Array) -> jax.Array:
+    return carry(-a, steps=2)
+
+
+def _columns(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Schoolbook product columns c[k] = sum_{i+j=k} a_i b_j -> [..., 67]
+    via the pad/reshape anti-diagonal trick (static shapes, no gathers)."""
+    outer = a[..., :, None] * b[..., None, :]  # [..., 33, 33], |.| < 2^24.2
+    padded = jnp.pad(
+        outer, [(0, 0)] * (outer.ndim - 2) + [(0, 0), (0, _NCOLS + 1 - LIMBS)]
+    )
+    flat = padded.reshape(*outer.shape[:-2], LIMBS * (_NCOLS + 1))
+    flat = flat[..., : LIMBS * _NCOLS]
+    return flat.reshape(*outer.shape[:-2], LIMBS, _NCOLS).sum(axis=-2)
+
+
+def mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a * b (mod p), reduced. Inputs must be reduced."""
+    c = _columns(a, b)  # |col| < 33 * 2^24.2 < 2^29.3
+    # Normalize columns before folding (fold rows are 12-bit, so columns
+    # must be ~12-bit first). Carries spill into columns 65/66, which start
+    # at zero; nothing falls off the end.
+    for _ in range(2):
+        cc = c >> LIMB_BITS
+        c = (c & LIMB_MASK) + jnp.concatenate(
+            [jnp.zeros_like(cc[..., :1]), cc[..., :-1]], axis=-1
+        )
+    lo = c[..., :32]
+    hi = c[..., 32:_NCOLS]  # 35 columns, weights 2^(12(j+32))
+    # fold: lo += hi @ FOLD — 35 products of ~2^12 * 2^12 per output limb,
+    # |acc| < 2^12 + 35 * 2^24.2 < 2^29.4
+    folded = lo + jnp.sum(
+        hi[..., :, None] * jnp.asarray(FOLD), axis=-2
+    )
+    out = jnp.concatenate(
+        [folded, jnp.zeros_like(folded[..., :1])], axis=-1
+    )  # limb 32 = 0
+    return carry(out, steps=3)
+
+
+def square(a: jax.Array) -> jax.Array:
+    return mul(a, a)
+
+
+def mul_small(a: jax.Array, k: int) -> jax.Array:
+    """a * k for python int 0 <= k < 2^12."""
+    return carry(a * jnp.int32(k), steps=3)
+
+
+def select(cond: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    """cond ? a : b, limb-wise; cond is bool[...] broadcast over limbs."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# --- canonicalization / predicates ----------------------------------------
+
+
+def _seq_pass(x: jax.Array) -> jax.Array:
+    """Exact sequential carry pass; limbs 0..31 end strict in [0, 2^12),
+    the top limb absorbs the tail and the 2^396 overflow folds back."""
+    carry_in = jnp.zeros_like(x[..., 0])
+    limbs = []
+    for i in range(LIMBS):
+        v = x[..., i] + carry_in
+        limbs.append(v & LIMB_MASK)
+        carry_in = v >> LIMB_BITS
+    out = jnp.stack(limbs, axis=-1)
+    return out + carry_in[..., None] * jnp.asarray(_FOLD_TOP)
+
+
+def _cond_sub(x: jax.Array, kp: np.ndarray) -> jax.Array:
+    """x - kp if that is non-negative else x (inputs strict-limbed)."""
+    d = x - jnp.asarray(kp)
+    carry_in = jnp.zeros_like(d[..., 0])
+    limbs = []
+    for i in range(LIMBS):
+        v = d[..., i] + carry_in
+        limbs.append(v & LIMB_MASK)
+        carry_in = v >> LIMB_BITS
+    sub_ok = carry_in >= 0  # no net borrow out the top
+    d_strict = jnp.stack(limbs, axis=-1)
+    return select(sub_ok, d_strict, x)
+
+
+def canonical(x: jax.Array) -> jax.Array:
+    """Unique representative in [0, p), limbs strictly in [0, 2^12)."""
+    # force positive, then normalize exactly
+    x = x + jnp.asarray(_BIG_P)
+    for _ in range(3):
+        x = _seq_pass(x)
+    # Fold the strict top limb (weight 2^384) down repeatedly. Each round
+    # shrinks the above-2^384 excess by ~2^-3.5 (2^384 mod p ~ 0.85 p ~
+    # 2^380.5): top < 2^12 -> 2^8.5 -> 2^5 -> 2^1.5 -> value < 1.4 * 2^384.
+    for _ in range(4):
+        top = x[..., 32]
+        x = jnp.concatenate(
+            [
+                x[..., :32] + top[..., None] * jnp.asarray(FOLD[0]),
+                jnp.zeros_like(x[..., 32:]),
+            ],
+            axis=-1,
+        )
+        x = _seq_pass(x)
+    # now value < 1.4 * 2^384 < 15p: binary conditional subtraction
+    for k in (8, 4, 2, 1):
+        x = _cond_sub(x, _KP[k])
+    return x
+
+
+def is_zero(x: jax.Array) -> jax.Array:
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return is_zero(sub(a, b))

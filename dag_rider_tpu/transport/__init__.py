@@ -1,6 +1,7 @@
 from dag_rider_tpu.transport.base import Handler, Transport
 from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
 from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.transport.rbc import RbcTransport
 
 __all__ = [
     "Handler",
@@ -8,4 +9,5 @@ __all__ = [
     "FaultPlan",
     "FaultyTransport",
     "InMemoryTransport",
+    "RbcTransport",
 ]

@@ -57,7 +57,12 @@ class FaultyTransport(Transport):
         dests = [d for d in self.inner.subscribers() if d != msg.sender]
         for dest in dests:
             out = msg
-            if msg.sender in self.plan.equivocators and self.rng.random() < 0.5:
+            if (
+                msg.kind == "val"
+                and msg.vertex is not None
+                and msg.sender in self.plan.equivocators
+                and self.rng.random() < 0.5
+            ):
                 out = dataclasses.replace(msg, vertex=self._equivocate(msg.vertex))
                 self.stats["equivocated"] += 1
             roll = self.rng.random()

@@ -19,6 +19,7 @@ class Metrics:
         self.counters: Dict[str, int] = defaultdict(int)
         self.verify_batch_seconds: List[float] = []
         self.verify_batch_sizes: List[int] = []
+        self.wave_commit_seconds: List[float] = []
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -26,6 +27,16 @@ class Metrics:
     def observe_verify_batch(self, size: int, seconds: float) -> None:
         self.verify_batch_sizes.append(size)
         self.verify_batch_seconds.append(seconds)
+
+    def observe_wave_commit(self, seconds: float) -> None:
+        """Duration of one decided wave's commit + total-order pass (the
+        BASELINE.json 'p50 wave-commit latency' sample source)."""
+        self.wave_commit_seconds.append(seconds)
+
+    @staticmethod
+    def _p50(samples: List[float]) -> float:
+        s = sorted(samples)
+        return s[len(s) // 2]
 
     def sigs_per_sec(self) -> float:
         total_t = sum(self.verify_batch_seconds)
@@ -37,8 +48,12 @@ class Metrics:
         out: Dict[str, float] = dict(self.counters)
         if self.verify_batch_sizes:
             out["verify_sigs_per_sec"] = self.sigs_per_sec()
-            lat = sorted(self.verify_batch_seconds)
-            out["verify_batch_p50_ms"] = 1e3 * lat[len(lat) // 2]
+            out["verify_batch_p50_ms"] = 1e3 * self._p50(self.verify_batch_seconds)
+            out["verify_batch_mean_size"] = sum(self.verify_batch_sizes) / len(
+                self.verify_batch_sizes
+            )
+        if self.wave_commit_seconds:
+            out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)
         return out
 
 

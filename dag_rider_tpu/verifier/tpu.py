@@ -18,7 +18,7 @@ the device returns only accept bits):
 Batches are padded to power-of-two buckets so XLA compiles a handful of
 program shapes, then results are sliced back. The accept mask is a pure
 function of (vertex bytes, registry) — identical to CPUVerifier's, which
-makes CPU-vs-TPU commit order byte-identical (tests/test_verifier_equiv.py).
+makes CPU-vs-TPU commit order byte-identical (tests/test_verifier_tpu.py).
 """
 
 from __future__ import annotations
@@ -179,6 +179,11 @@ class TPUVerifier(Verifier):
         if not vertices:
             return []
         size = _bucket(len(vertices))
-        args = self._prepare(vertices, size)
-        mask = np.asarray(_device_verify(*(jnp.asarray(a) for a in args)))
+        # Trace annotations are free when no profiler is attached; under
+        # jax.profiler.trace() (bench.py --profile / SURVEY §5) they label
+        # the host-prep vs device-dispatch split per round.
+        with jax.profiler.TraceAnnotation("verify_batch.prepare"):
+            args = self._prepare(vertices, size)
+        with jax.profiler.TraceAnnotation("verify_batch.dispatch"):
+            mask = np.asarray(_device_verify(*(jnp.asarray(a) for a in args)))
         return [bool(m) for m in mask[: len(vertices)]]

@@ -1,0 +1,144 @@
+"""Threshold-BLS common coin, end to end through the consensus pipeline.
+
+Round-1 review: ``ThresholdCoin`` was unit-tested but never ran inside a
+``Process``/``Simulation`` — the share-piggyback path
+(``process.py`` round(w,4) share attach + ``observe_share`` on admission)
+and the pending-wave retry machinery had no e2e coverage. These tests run
+the real coin (crypto/threshold.py over crypto/bls12381.py, the design the
+reference's TODO names at ``process/process.go:388``) inside N-node
+simulations, including a Byzantine share in flight.
+"""
+
+import pytest
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.coin import ThresholdCoin
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.crypto import threshold as th
+
+
+@pytest.fixture(scope="module")
+def keys():
+    # n=4, f=1 -> (f+1)=2-of-4 threshold, as the reference TODO specifies
+    return th.ThresholdKeys.generate(4, 2)
+
+
+def run_sim(keys, coin_factory, blocks=6):
+    cfg = Config(n=4, coin="threshold_bls", propose_empty=False)
+    sim = Simulation(cfg, coin_factory=coin_factory)
+    sim.submit_blocks(per_process=blocks)
+    sim.run(max_messages=20_000)
+    return sim
+
+
+def test_threshold_coin_decides_waves_and_agrees(keys):
+    coins = {}
+
+    def factory(i):
+        coins[i] = ThresholdCoin(keys, i, 4)
+        return coins[i]
+
+    sim = run_sim(keys, factory)
+    sim.check_agreement()
+    decided = [p.metrics.counters["waves_decided"] for p in sim.processes]
+    assert any(d >= 1 for d in decided), decided
+    # Coin agreement: every process that evaluated wave w's coin got the
+    # same group signature, hence the same leader.
+    sigmas = {}
+    for i, coin in coins.items():
+        for wave, sigma in coin._sigma.items():
+            sigmas.setdefault(wave, set()).add(sigma)
+    assert sigmas, "no coin was ever evaluated"
+    for wave, values in sigmas.items():
+        assert len(values) == 1, f"wave {wave} coin diverged"
+    # Unpredictability sanity: the leader must come from the group
+    # signature, not a fixed index pattern.
+    leaders = {w: th.leader_from_sigma(next(iter(v)), 4) for w, v in sigmas.items()}
+    assert all(0 <= l < 4 for l in leaders.values())
+
+
+class LaggyCoin:
+    """Round-robin coin whose readiness lags: ``ready(w)`` is False for the
+    first ``lag`` polls of each wave. Forces the wave boundary down the
+    ``_pending_waves`` path so the retry machinery
+    (``Process._retry_pending_waves``) is what actually commits."""
+
+    def __init__(self, n: int, lag: int = 3):
+        self.n = n
+        self.lag = lag
+        self.polls = {}
+
+    def ready(self, wave: int) -> bool:
+        c = self.polls.get(wave, 0) + 1
+        self.polls[wave] = c
+        return c > self.lag
+
+    def choose_leader(self, wave: int) -> int:
+        return wave % self.n
+
+    def my_share(self, wave):
+        return None
+
+    def observe_share(self, wave, source, share):
+        pass
+
+
+def test_pending_wave_retry_commits_when_coin_becomes_ready():
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    coins = {}
+
+    def factory(i):
+        coins[i] = LaggyCoin(4)
+        return coins[i]
+
+    sim = Simulation(cfg, coin_factory=factory)
+    sim.submit_blocks(per_process=6)
+    sim.run(max_messages=20_000)
+    sim.check_agreement()
+    assert any(p.metrics.counters["waves_decided"] >= 1 for p in sim.processes)
+    # the lag really engaged: every coin was polled more than once per wave
+    assert all(any(c > 1 for c in coin.polls.values()) for coin in coins.values())
+
+
+class ByzantineShareCoin(ThresholdCoin):
+    """Signs the wrong message — a share that decompresses fine but fails
+    the pairing check, poisoning the first aggregation attempt."""
+
+    def my_share(self, wave: int):
+        return th.sign_share(self.keys.share_sks[self.index], wave + 991)
+
+
+def test_byzantine_share_cannot_stall_the_coin(keys):
+    """Process 0 contributes corrupt shares every wave. Its index sorts
+    first, so the lazy first combination includes the bad share and fails
+    the group check — the individual-filter path must discard it and the
+    remaining honest shares must still produce the (identical) coin."""
+    coins = {}
+
+    def factory(i):
+        cls = ByzantineShareCoin if i == 0 else ThresholdCoin
+        coins[i] = cls(keys, i, 4)
+        return coins[i]
+
+    sim = run_sim(keys, factory)
+    sim.check_agreement()
+    assert any(
+        p.metrics.counters["waves_decided"] >= 1 for p in sim.processes
+    )
+    # honest coins agree despite the poisoned share
+    sigmas = {}
+    for i, coin in coins.items():
+        if i == 0:
+            continue
+        for wave, sigma in coin._sigma.items():
+            sigmas.setdefault(wave, set()).add(sigma)
+    assert sigmas and all(len(v) == 1 for v in sigmas.values())
+    # the filter actually fired somewhere: some honest process dropped the
+    # bad share from its pool after a failed combination
+    filtered = any(
+        0 not in coin._shares.get(wave, {0: None})
+        for i, coin in coins.items()
+        if i != 0
+        for wave in coin._sigma
+    )
+    assert filtered

@@ -1,0 +1,140 @@
+"""Multi-chip parallel package: sharded verify and the fused round step.
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py forces
+``--xla_force_host_platform_device_count=8``). Asserts the north-star
+invariant for the sharded path: the accept mask is the *same bits* whether
+computed by the host CPUVerifier, the single-device TPUVerifier, or the
+mesh-sharded ShardedTPUVerifier — sharding must never change results, only
+placement (SURVEY.md §2b).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tests import fixtures
+from dag_rider_tpu.core.types import Block, Vertex, VertexID
+from dag_rider_tpu.ops import dag_kernels
+from dag_rider_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+from dag_rider_tpu.parallel.round_step import make_round_step
+from dag_rider_tpu.parallel.sharded_verifier import ShardedTPUVerifier
+from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+from dag_rider_tpu.verifier.cpu import CPUVerifier
+from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return KeyRegistry.generate(8)
+
+
+@pytest.fixture(scope="module")
+def batch(keys):
+    reg, seeds = keys
+    signers = [VertexSigner(s) for s in seeds]
+    vs = []
+    for i in range(8):
+        v = Vertex(
+            id=VertexID(2, i),
+            block=Block((f"tx-{i}".encode(),)),
+            strong_edges=tuple(VertexID(1, s) for s in range(6)),
+        )
+        vs.append(signers[i].sign_vertex(v))
+    # corruptions: bad signature, swapped signature, tampered payload
+    vs.append(dataclasses.replace(vs[0], signature=b"\x01" * 64))
+    vs.append(dataclasses.replace(vs[1], signature=vs[2].signature))
+    vs.append(dataclasses.replace(vs[3], block=Block((b"tampered",))))
+    return vs
+
+
+def test_mesh_shapes(mesh):
+    assert mesh.devices.shape == (8,)
+    assert mesh.axis_names == ("batch",)
+    s = batch_sharding(mesh)
+    assert s.spec == jax.sharding.PartitionSpec("batch")
+    assert replicated(mesh).spec == jax.sharding.PartitionSpec()
+
+
+def test_sharded_mask_equals_single_device_and_cpu(keys, batch):
+    reg, _ = keys
+    cpu = CPUVerifier(reg).verify_batch(batch)
+    tpu = TPUVerifier(reg).verify_batch(batch)
+    sharded = ShardedTPUVerifier(reg).verify_batch(batch)
+    assert cpu == tpu == sharded
+    assert sharded[:8] == [True] * 8
+    assert sharded[8:] == [False] * 3
+
+
+def test_sharded_batch_actually_sharded(keys, batch, mesh):
+    """The dispatch input must lay out over the 8 mesh devices (one shard
+    per device), not replicate."""
+    reg, _ = keys
+    v = ShardedTPUVerifier(reg, mesh)
+    size = v._bucket_size(len(batch))
+    assert size % 8 == 0
+    args = v._prepare(batch, size)
+    arr = jax.device_put(jnp.asarray(args[0]), batch_sharding(mesh))
+    assert len(arr.sharding.device_set) == 8
+
+
+def test_round_step_matches_host_twins_on_figure1(keys, batch, mesh):
+    """The fused sharded round step must agree bit-for-bit with (a) the
+    unsharded verifier mask and (b) the host-side wave-commit twin, on the
+    golden Figure-1 wave."""
+    reg, _ = keys
+    quorum = 3
+    step = make_round_step(mesh, quorum=quorum)
+
+    tpu = TPUVerifier(reg)
+    size = 16  # multiple of the mesh, >= len(batch)
+    args = tuple(jnp.asarray(a) for a in tpu._prepare(batch, size))
+
+    exists, strong, _ = fixtures.figure1_tensors()
+    # wave 1: rounds (4,3,2] adjacency, top first; leader at round 1
+    strong_wave = jnp.asarray(strong[4:1:-1])
+    exists_r4 = jnp.asarray(exists[4])
+    for leader in range(4):
+        accept, commit, votes = step(
+            *args, strong_wave, exists_r4, jnp.int32(leader)
+        )
+        # (a) verify mask identical to the unsharded dispatch
+        expected_mask = tpu.verify_batch(batch)
+        assert [bool(m) for m in np.asarray(accept)[: len(batch)]] == expected_mask
+        # (b) wave-commit identical to the host numpy twin
+        reach = np.eye(4, dtype=bool)
+        for k in range(3):
+            reach = (
+                reach.astype(np.int32) @ np.asarray(strong_wave[k]).astype(np.int32)
+            ) > 0
+        host_votes = reach[:, leader] & np.asarray(exists_r4)
+        assert (np.asarray(votes) == host_votes).all()
+        assert bool(commit) == (int(host_votes.sum()) >= quorum)
+
+
+def test_round_step_kernel_matches_unfused_kernels(mesh):
+    """wave_commit_votes inside the fused step == the standalone kernel."""
+    exists, strong, _ = fixtures.figure1_tensors()
+    strong_wave = jnp.asarray(strong[4:1:-1])
+    exists_r4 = jnp.asarray(exists[4])
+    commit, votes = dag_kernels.wave_commit_votes(
+        strong_wave, exists_r4, jnp.int32(0), quorum=3
+    )
+    # Figure 1: only (4,0) exists with edges; reference fixture gives round-4
+    # vertex p0 a path to round-1 p0 via rounds 3,2.
+    reach = np.eye(4, dtype=bool)
+    for k in range(3):
+        reach = (
+            reach.astype(np.int32) @ np.asarray(strong_wave[k]).astype(np.int32)
+        ) > 0
+    host_votes = reach[:, 0] & np.asarray(exists_r4)
+    assert (np.asarray(votes) == host_votes).all()
+    assert bool(commit) == (int(host_votes.sum()) >= 3)

@@ -1,0 +1,139 @@
+"""Bracha reliable broadcast: consistency under equivocation, totality,
+and the consensus pipeline running over the RBC stage.
+
+The reference's transport is "reliable" by fiat (``transport.go:5``) and its
+equivocation story is nonexistent (SURVEY.md D10); round-1 review showed an
+equivocator could get *different signed payloads admitted at different
+honest nodes*. These tests pin the fix: with RbcTransport, at most one
+payload per (round, source) slot is ever delivered anywhere, and
+``Simulation.check_agreement`` now compares delivered digests.
+"""
+
+import dataclasses
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
+from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.transport.rbc import RbcTransport
+
+
+def mk_vertex(source=0, rnd=1, payload=b"tx"):
+    return Vertex(
+        id=VertexID(rnd, source),
+        block=Block((payload,)),
+        strong_edges=(VertexID(rnd - 1, 0), VertexID(rnd - 1, 1), VertexID(rnd - 1, 2)),
+    )
+
+
+def build_cluster(n=4, f=1):
+    broker = InMemoryTransport()
+    rbcs, sinks = [], []
+    for i in range(n):
+        rbc = RbcTransport(broker, i, n, f)
+        sink = []
+        rbc.subscribe(i, sink.append)
+        rbcs.append(rbc)
+        sinks.append(sink)
+    return broker, rbcs, sinks
+
+
+def test_honest_broadcast_delivers_everywhere_once():
+    broker, rbcs, sinks = build_cluster()
+    v = mk_vertex(source=0)
+    rbcs[0].broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+    broker.pump()
+    # sender does not self-deliver (its Process inserts its own vertex)
+    assert sinks[0] == []
+    for sink in sinks[1:]:
+        assert [m.vertex.digest() for m in sink] == [v.digest()]
+
+
+def test_equivocation_cannot_diverge_and_fetch_recovers_payload():
+    """Byzantine p0 sends payload A to p1 and payload B to p2/p3, then
+    echoes B itself. B reaches echo quorum; p1 must deliver B (via FETCH —
+    it only ever held A), and nobody delivers A."""
+    broker, rbcs, sinks = build_cluster()
+    va = mk_vertex(source=0, payload=b"A")
+    vb = mk_vertex(source=0, payload=b"B")
+    broker.enqueue(1, BroadcastMessage(vertex=va, round=1, sender=0))
+    for dest in (2, 3):
+        broker.enqueue(dest, BroadcastMessage(vertex=vb, round=1, sender=0))
+    # the equivocator's own (lying) echo for B
+    broker.broadcast(
+        BroadcastMessage(
+            vertex=None,
+            round=1,
+            sender=0,
+            kind="echo",
+            origin=0,
+            digest=vb.digest(),
+        )
+    )
+    broker.pump()
+    for i in (1, 2, 3):
+        assert [m.vertex.digest() for m in sinks[i]] == [vb.digest()], i
+    assert all(m.vertex.block.transactions == (b"B",) for s in sinks[1:] for m in s)
+
+
+def test_forged_sender_cannot_hijack_a_slot():
+    """A Byzantine peer (p3) sends a VAL whose vertex claims slot (1, p0)
+    before p0's real broadcast. The forgery must be ignored (sender stamp
+    != vertex source), and p0's genuine vertex must still deliver."""
+    broker, rbcs, sinks = build_cluster()
+    forged = mk_vertex(source=0, payload=b"forged")
+    real = mk_vertex(source=0, payload=b"real")
+    # forgery arrives first, stamped by its actual sender p3
+    broker.broadcast(BroadcastMessage(vertex=forged, round=1, sender=3))
+    broker.pump()
+    assert all(not s for s in sinks)
+    rbcs[0].broadcast(BroadcastMessage(vertex=real, round=1, sender=0))
+    broker.pump()
+    for sink in sinks[1:]:
+        assert [m.vertex.block.transactions for m in sink] == [(b"real",)]
+
+
+def test_minority_equivocation_delivers_nothing():
+    """Conflicting VALs split 1/2 with no extra votes: neither digest can
+    reach the 2f+1 echo quorum, so no honest process delivers anything —
+    consistency preserved by silence."""
+    broker, rbcs, sinks = build_cluster()
+    va = mk_vertex(source=0, payload=b"A")
+    vb = mk_vertex(source=0, payload=b"B")
+    broker.enqueue(1, BroadcastMessage(vertex=va, round=1, sender=0))
+    broker.enqueue(2, BroadcastMessage(vertex=vb, round=1, sender=0))
+    broker.enqueue(3, BroadcastMessage(vertex=vb, round=1, sender=0))
+    broker.pump()
+    assert all(not s for s in sinks)
+
+
+def test_consensus_pipeline_over_rbc():
+    """Full DAG-Rider over the RBC stage: agreement (by digest) and waves
+    decided, with RBC control traffic riding the same broker."""
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    sim = Simulation(cfg, rbc=True)
+    sim.submit_blocks(per_process=6)
+    sim.run(max_messages=30_000)
+    sim.check_agreement()
+    assert any(p.metrics.counters["waves_decided"] >= 1 for p in sim.processes)
+    delivered = sum(len(d) for d in sim.deliveries)
+    assert delivered > 0
+    ignored = sum(p.metrics.counters["msgs_ignored_kind"] for p in sim.processes)
+    assert ignored == 0, "control traffic must never reach a Process"
+
+
+def test_equivocating_sender_with_rbc_stays_consistent():
+    """The round-1 gap scenario, closed: a FaultyTransport equivocator
+    under the RBC stage cannot get divergent payloads admitted; delivered
+    content is identical at all honest processes (digest-level agreement)."""
+    plan = FaultPlan(equivocators=(3,), seed=9)
+    tp = FaultyTransport(plan)
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    sim = Simulation(cfg, transport=tp, rbc=True)
+    sim.submit_blocks(per_process=4)
+    sim.run(max_messages=30_000)
+    sim.check_agreement()
+    assert tp.stats["equivocated"] > 0  # the attack actually fired
+    delivered = sum(len(d) for d in sim.deliveries)
+    assert delivered > 0
