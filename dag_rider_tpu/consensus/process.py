@@ -350,13 +350,34 @@ class Process:
         ``process.go:303-309`` — here one incremental closure bitmap)."""
         if rnd < 3:
             return ()
-        reached = self.dag.closure(list(strong), strong_only=False)
+        dag = self.dag
+        n = self.cfg.n
+        # Single backward sweep, O(R*n^2) total (round-2 VERDICT weak #5:
+        # the previous version recomputed a full closure per straggler).
+        # Invariant: when the sweep reaches round r, reached[r] is the set
+        # of round-r vertices in the causal history of v via all higher
+        # rounds — valid because after processing a round every existing
+        # vertex there is *covered* (reachable or freshly weak-linked), so
+        # covered vertices' out-edges are exactly what must propagate.
+        # Order within a round is irrelevant (edges only cross rounds).
+        reached = np.zeros((rnd, n), dtype=bool)
+        covered = np.zeros(n, dtype=bool)
+        for e in strong:  # frontier round rnd-1: covered = strong targets
+            covered[e.source] = True
         weak: List[VertexID] = []
-        for r in range(rnd - 2, 0, -1):
-            for u in self.dag.vertices_in_round(r):
-                if not reached[r, u.source]:
-                    weak.append(u.id)
-                    reached |= self.dag.closure([u.id], strong_only=False)
+        for r in range(rnd - 1, 0, -1):
+            if r <= rnd - 2:
+                covered = reached[r].copy()
+                for u in dag.vertices_in_round(r):
+                    if not covered[u.source]:
+                        weak.append(u.id)
+                        covered[u.source] = True
+            if r == 1:
+                break  # round 0 is genesis; nothing below to propagate to
+            reached[r - 1] |= covered @ dag.strong[r]
+            for i in np.flatnonzero(covered):
+                for (r2, j) in dag.weak.get((r, i), ()):
+                    reached[r2, j] = True
         return tuple(weak)
 
     # ------------------------------------------------------------------

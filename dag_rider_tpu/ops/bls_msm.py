@@ -113,11 +113,20 @@ def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
     nibbles: int32[..., 64], little-endian. The window walk is a fori_loop
     so the HLO stays one window long regardless of scalar size.
     """
-    entries = [identity(nibbles.shape[:-1]), p]
-    for _ in range(14):
-        entries.append(padd(entries[-1], p))
+    # Radix-16 table via scan: one padd body in the HLO instead of 14
+    # inlined ones (compile-time win; identical values).
+    ident = identity(nibbles.shape[:-1])
+
+    def _entry(prev, _):
+        nxt = padd(prev, p)
+        return nxt, nxt
+
+    _, steps = jax.lax.scan(_entry, ident, None, length=15)
     table = tuple(
-        jnp.stack([e[c] for e in entries], axis=-2) for c in range(3)
+        jnp.moveaxis(
+            jnp.concatenate([ident[c][None], steps[c]], axis=0), 0, -2
+        )
+        for c in range(3)
     )
 
     def body(i, acc):

@@ -153,12 +153,21 @@ def scalar_mul_var(nibbles: jax.Array, a: Point) -> Point:
     252 doublings + 63 adds + 14 table-build adds, all batched; the window
     walk is a fori_loop so the HLO stays one window long.
     """
-    # Window table 0..15: T[d] = d * A.
-    entries = [identity(nibbles.shape[:-1]), a]
-    for _ in range(14):
-        entries.append(padd(entries[-1], a))
+    # Window table 0..15: T[d] = d * A. Built with a scan (one padd body
+    # in the HLO instead of 14 inlined ones — round-2 VERDICT next #1c:
+    # smaller program, faster compile; same values).
+    ident = identity(nibbles.shape[:-1])
+
+    def _entry(prev, _):
+        nxt = padd(prev, a)
+        return nxt, nxt
+
+    _, steps = jax.lax.scan(_entry, ident, None, length=15)
     table = tuple(
-        jnp.stack([e[c] for e in entries], axis=-2) for c in range(4)
+        jnp.moveaxis(
+            jnp.concatenate([ident[c][None], steps[c]], axis=0), 0, -2
+        )
+        for c in range(4)
     )
 
     def body(i, acc):

@@ -60,6 +60,7 @@ def save(process, path: str) -> None:
             [vid.round, vid.source] for vid in process.delivered_log
         ],
         "waves_tried": sorted(process._waves_tried),
+        "pending_waves": sorted(process._pending_waves),
         "blocks_to_propose": [
             [tx.hex() for tx in b.transactions]
             for b in process.blocks_to_propose
@@ -113,6 +114,21 @@ def restore(process, path: str) -> None:
     process.round = manifest["round"]
     process.decided_wave = manifest["decided_wave"]
     process._waves_tried = set(manifest["waves_tried"])
+    # A wave pending on an unready coin at save time must re-enter
+    # _try_wave after restore, or its direct commit is silently skipped
+    # (round-2 VERDICT weak #7). Older manifests lack the key; recompute
+    # conservatively: every tried-but-undecided wave re-arms (re-trying a
+    # decided wave is a no-op — _try_wave guards on decided_wave).
+    process._pending_waves = set(
+        manifest.get(
+            "pending_waves",
+            [
+                w
+                for w in manifest["waves_tried"]
+                if w > manifest["decided_wave"]
+            ],
+        )
+    )
     process.delivered_log = [
         VertexID(r, s) for r, s in manifest["delivered_log"]
     ]

