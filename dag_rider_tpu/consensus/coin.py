@@ -124,12 +124,12 @@ class ThresholdCoin(CommonCoin):
         ):
             self._sigma[wave] = sigma
             return
-        # Byzantine share in the first combination: filter individually.
-        good = {
-            src: sh
-            for src, sh in shares.items()
-            if self._th.verify_share(self.keys.share_pks[src], wave, sh)
-        }
+        # Byzantine share in the first combination: batched filter (RLC +
+        # GT-defect localization — one pairing product for the honest
+        # remainder instead of one pairing per share).
+        good = self._th.batch_verify_shares(
+            self.keys.share_pks, wave, shares, msm=self._msm
+        )
         self._shares[wave] = good
         if len(good) >= self.keys.threshold:
             sigma = self._th.aggregate(good, self.keys.threshold, msm=self._msm)

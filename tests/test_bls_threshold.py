@@ -131,3 +131,64 @@ def test_coin_wave_dependence(keys):
         sigma = th.aggregate(shares, 2)
         leaders.add(th.leader_from_sigma(sigma, 4))
     assert len(leaders) >= 2
+
+
+# ----------------------------------------------------------------------
+# Jacobian MSM + batched share verification (round-2 VERDICT weak #4)
+# ----------------------------------------------------------------------
+
+
+def test_g1_g2_msm_match_affine_oracle():
+    import random
+
+    rng = random.Random(5)
+    pts1 = [bls.g1_mul(rng.randrange(1, bls.R)) for _ in range(7)]
+    pts2 = [bls.g2_mul(rng.randrange(1, bls.R)) for _ in range(7)]
+    ks = [rng.randrange(0, bls.R) for _ in range(7)]
+    ks[3] = 0  # zero scalar must drop out
+    want1 = None
+    want2 = None
+    for k, p1, p2 in zip(ks, pts1, pts2):
+        want1 = bls.g1_add(want1, bls.g1_mul(k, p1))
+        want2 = bls.g2_add(want2, bls.g2_mul(k, p2))
+    assert bls.g1_msm(ks, pts1) == want1
+    assert bls.g2_msm(ks, pts2) == want2
+    # identity results
+    assert bls.g1_msm([0, 0], pts1[:2]) is None
+    assert bls.g1_msm([1, bls.R - 1], [pts1[0], pts1[0]]) is None  # P + (-P)
+
+
+def test_batch_verify_shares_all_honest():
+    keys = th.ThresholdKeys.generate(7, 3)
+    wave = 4
+    shares = {i: th.sign_share(keys.share_sks[i], wave) for i in range(5)}
+    good = th.batch_verify_shares(keys.share_pks, wave, shares)
+    assert good == shares
+
+
+def test_batch_verify_shares_one_bad_localized():
+    keys = th.ThresholdKeys.generate(7, 3)
+    wave = 9
+    shares = {i: th.sign_share(keys.share_sks[i], wave) for i in range(6)}
+    shares[2] = th.sign_share(keys.share_sks[2], wave + 1)  # wrong message
+    good = th.batch_verify_shares(keys.share_pks, wave, shares)
+    assert set(good) == {0, 1, 3, 4, 5}
+
+
+def test_batch_verify_shares_multiple_bad_and_undecodable():
+    keys = th.ThresholdKeys.generate(8, 3)
+    wave = 2
+    shares = {i: th.sign_share(keys.share_sks[i], wave) for i in range(8)}
+    shares[1] = th.sign_share(keys.share_sks[0], wave)  # wrong signer
+    shares[4] = th.sign_share(keys.share_sks[4], wave + 7)  # wrong message
+    shares[6] = b"\x00" * 48  # undecodable
+    good = th.batch_verify_shares(keys.share_pks, wave, shares)
+    assert set(good) == {0, 2, 3, 5, 7}
+
+
+def test_batch_verify_shares_all_bad():
+    keys = th.ThresholdKeys.generate(4, 2)
+    shares = {
+        i: th.sign_share(keys.share_sks[i], 99) for i in range(3)
+    }  # all for the wrong wave
+    assert th.batch_verify_shares(keys.share_pks, 1, shares) == {}
