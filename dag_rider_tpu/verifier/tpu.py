@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -202,15 +203,24 @@ class TPUVerifier(Verifier):
             prevalid,
         )
 
+    #: host-prep / device-dispatch seconds of the most recent
+    #: verify_batch call — the host/device split the bench reports.
+    last_prepare_s: float = 0.0
+    last_dispatch_s: float = 0.0
+
     def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
         if not vertices:
             return []
         size = _bucket(len(vertices))
         # Trace annotations are free when no profiler is attached; under
-        # jax.profiler.trace() (bench.py --profile / SURVEY §5) they label
-        # the host-prep vs device-dispatch split per round.
+        # jax.profiler.trace() (bench.py DAGRIDER_PROFILE_DIR / SURVEY §5)
+        # they label the host-prep vs device-dispatch split per round.
+        t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.prepare"):
             args = self._prepare(vertices, size)
+        self.last_prepare_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.dispatch"):
             mask = np.asarray(_device_verify(*(jnp.asarray(a) for a in args)))
+        self.last_dispatch_s = time.perf_counter() - t0
         return [bool(m) for m in mask[: len(vertices)]]

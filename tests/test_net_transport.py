@@ -116,3 +116,137 @@ def test_remote_verifier_fails_closed():
     v = Vertex(id=VertexID(1, 0))
     assert remote.verify_batch([v, v]) == [False, False]
     remote.close()
+
+
+# ----------------------------------------------------------------------
+# Observability + retry (round-2 VERDICT weak #8)
+# ----------------------------------------------------------------------
+
+
+def test_grpc_send_counters_on_success(grpc_cluster):
+    import time
+
+    transports = grpc_cluster
+    got = []
+    transports[1].subscribe(1, got.append)
+    v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+    transports[0].broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+    deadline = time.time() + 5
+    while time.time() < deadline and (
+        transports[0].metrics.counters.get("net_sends_ok", 0) < 3
+    ):
+        time.sleep(0.01)
+    c = transports[0].metrics.counters
+    assert c["net_sends"] == 3
+    assert c["net_sends_ok"] == 3
+    assert c.get("net_drops", 0) == 0
+
+
+def test_grpc_retry_then_drop_on_dead_peer():
+    import time
+
+    # peer 1 points at a port with nothing listening
+    t0 = GrpcTransport(
+        0,
+        "127.0.0.1:0",
+        {1: "127.0.0.1:1"},
+        retries=2,
+        retry_backoff_s=0.01,
+        rpc_timeout_s=0.3,
+    )
+    try:
+        v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+        t0.broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+        deadline = time.time() + 10
+        while time.time() < deadline and t0.metrics.counters.get("net_drops", 0) < 1:
+            time.sleep(0.02)
+        c = t0.metrics.counters
+        assert c["net_send_errors"] == 3  # initial + 2 retries
+        assert c["net_retries"] == 2
+        assert c["net_drops"] == 1
+    finally:
+        t0.close()
+
+
+def test_grpc_attach_metrics_merges_counters(grpc_cluster):
+    import time
+
+    from dag_rider_tpu.utils.metrics import Metrics
+
+    transports = grpc_cluster
+    transports[2].subscribe(2, lambda m: None)
+    v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+    transports[0].broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+    deadline = time.time() + 5
+    while time.time() < deadline and (
+        transports[0].metrics.counters.get("net_sends_ok", 0) < 3
+    ):
+        time.sleep(0.01)
+    shared = Metrics()
+    shared.inc("vertices_admitted", 7)  # pre-existing consensus counter
+    transports[0].attach_metrics(shared)
+    snap = shared.snapshot()
+    assert snap["net_sends"] == 3 and snap["vertices_admitted"] == 7
+    # post-attach traffic lands in the shared Metrics
+    transports[0].broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+    deadline = time.time() + 5
+    while time.time() < deadline and shared.counters.get("net_sends", 0) < 6:
+        time.sleep(0.01)
+    assert shared.counters["net_sends"] == 6
+
+
+def test_grpc_16_node_cluster_with_rbc_reaches_consensus():
+    """BASELINE rung #2 shape at n=16, over real gRPC sockets, with the
+    Bracha RBC stage in the path (round-2 VERDICT next #10)."""
+    import time
+
+    from dag_rider_tpu.transport.rbc import RbcTransport
+
+    n = 16
+    cfg = Config(n=n, coin="round_robin", propose_empty=False)
+    nets = [GrpcTransport(i, "127.0.0.1:0", {}) for i in range(n)]
+    addrs = {i: f"127.0.0.1:{t.bound_port}" for i, t in enumerate(nets)}
+    for t in nets:
+        t._peers.update(addrs)
+    try:
+        rbcs = [RbcTransport(nets[i], i, n, cfg.f) for i in range(n)]
+        delivered = [[] for _ in range(n)]
+        procs = [
+            Process(
+                cfg, i, rbcs[i], on_deliver=delivered[i].append
+            )
+            for i in range(n)
+        ]
+        for p in procs:
+            p.defer_steps = True  # burst delivery, one step per pump pass
+            # 10 blocks/process: wave 2's boundary is round 8, so the
+            # cluster must outlive round 8 for a multi-wave leader chain
+            # (wave 1 alone delivers only the leader's 1-vertex history).
+            for k in range(10):
+                p.submit(Block((f"p{p.index}-b{k}".encode(),)))
+        for p in procs:
+            p.start()
+        deadline = time.time() + 120
+        while time.time() < deadline and not all(
+            len(d) >= n for d in delivered
+        ):
+            moved = False
+            for t in nets:
+                moved |= t.pump(64) > 0
+            for p in procs:
+                p.step()
+            if not moved:
+                time.sleep(0.002)
+        assert all(len(d) >= n for d in delivered), [
+            len(d) for d in delivered
+        ]
+        logs = [[(v.id.round, v.id.source, v.digest()) for v in d] for d in delivered]
+        k = min(len(l) for l in logs)
+        assert all(l[:k] == logs[0][:k] for l in logs)
+        # RBC really was in the path: every process echoed and readied
+        assert all(r._delivered for r in rbcs)
+        # transport observability: sends counted on every node
+        assert all(t.metrics.counters["net_sends"] > 0 for t in nets)
+    finally:
+        for t in nets:
+            t.close()
