@@ -92,7 +92,7 @@ def _probe() -> None:
 # Stage: measure (phased, deadline-aware, cumulative JSON after each phase)
 # ----------------------------------------------------------------------
 
-def _build_batches(n: int, rounds: int, verifier=None):
+def _build_batches(n: int, rounds: int):
     from dag_rider_tpu.core.types import Block, Vertex, VertexID
     from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
     from dag_rider_tpu.verifier.tpu import TPUVerifier
@@ -119,7 +119,7 @@ def _build_batches(n: int, rounds: int, verifier=None):
             v.digest()
             vs.append(v)
         batches.append(vs)
-    return (verifier if verifier is not None else TPUVerifier(reg)), batches
+    return TPUVerifier(reg), batches
 
 
 def _measure() -> None:
@@ -162,12 +162,15 @@ def _measure() -> None:
     def emit() -> None:
         print(json.dumps(result), flush=True)
 
+    built = {}  # n -> (verifier, batches); reused by the wave phase
+
     def verify_phase(n: int, timed_rounds: int) -> bool:
         """One committee size: build, compile/warm, measure. Returns ok."""
         tag = f"verify_n{n}"
         _mark(f"{tag}: building {1 + timed_rounds} signed rounds")
         t0 = time.monotonic()
         verifier, batches = _build_batches(n, 1 + timed_rounds)
+        built[n] = (verifier, batches)
         build_s = time.monotonic() - t0
         _mark(f"{tag}: build done in {build_s:.1f}s; compiling (warm batch)")
         t0 = time.monotonic()
@@ -180,20 +183,22 @@ def _measure() -> None:
         profile_dir = os.environ.get("DAGRIDER_PROFILE_DIR")
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
-        total = 0
-        t0 = time.monotonic()
-        prep_s = 0.0
-        for k, b in enumerate(batches[1:]):
-            mask = verifier.verify_batch(b)
-            prep_s += verifier.last_prepare_s
-            total += len(b)
-            if not all(mask):
-                _mark(f"{tag}: timed batch {k} failed")
-                return False
-            _mark(f"{tag}: timed batch {k} done")
-        dt = time.monotonic() - t0
-        if profile_dir:
-            jax.profiler.stop_trace()
+        try:
+            total = 0
+            t0 = time.monotonic()
+            prep_s = 0.0
+            for k, b in enumerate(batches[1:]):
+                mask = verifier.verify_batch(b)
+                prep_s += verifier.last_prepare_s
+                total += len(b)
+                if not all(mask):
+                    _mark(f"{tag}: timed batch {k} failed")
+                    return False
+                _mark(f"{tag}: timed batch {k} done")
+            dt = time.monotonic() - t0
+        finally:
+            if profile_dir:
+                jax.profiler.stop_trace()
         sigs = total / dt
         _mark(
             f"{tag}: {sigs:,.0f} sigs/s  (host prep {1e3 * prep_s / timed_rounds:.1f}"
@@ -242,9 +247,8 @@ def _measure() -> None:
             lambda s, e, l: dag_kernels.wave_commit_votes(s, e, l, quorum=quorum)
         )
         jax.block_until_ready(commit_fn(strong_wave, exists_r4, leader))
-        verifier, batches = _build_batches(n, 4)
-        for b in batches:  # warm the verify program for this n
-            verifier.verify_batch(b)
+        # reuse the already-built, already-warm batches from verify_phase
+        verifier, batches = built[n]
         strong_np = np.asarray(strong_wave)
         wave_ms = []
         for w in range(6):
@@ -355,6 +359,70 @@ def _measure() -> None:
     else:
         _mark(f"skipping ladder coin256 (only {left():.0f}s left)")
 
+    # -- ladder rung #5 (single-host half): T-point G1 MSM on the device
+    msm_t = int(os.environ.get("DAGRIDER_BENCH_MSM_T", "1024"))
+    if msm_t > 0 and left() > 120:
+        _mark(f"ladder msm{msm_t}: building points")
+        import random
+
+        from dag_rider_tpu.crypto import bls12381 as bls
+        from dag_rider_tpu.parallel.msm import ShardedMSM
+
+        rng = random.Random(3)
+        base = bls.g1_mul(rng.randrange(1, bls.R))
+        pts, acc = [], base
+        for _ in range(msm_t):  # cheap distinct points: repeated doubling
+            pts.append(acc)
+            acc = bls.g1_double(acc)
+        ks = [rng.randrange(0, bls.R) for _ in range(msm_t)]
+        sm = ShardedMSM()
+        _mark(f"ladder msm{msm_t}: compiling + first run")
+        t0 = time.monotonic()
+        first = sm(ks, pts)
+        compile_s = time.monotonic() - t0
+        _mark(f"ladder msm{msm_t}: first run {compile_s:.1f}s; timing warm run")
+        t0 = time.monotonic()
+        warm = sm(ks, pts)
+        dt = time.monotonic() - t0
+        ok = first == warm and first is not None
+        result["ladder"][f"msm{msm_t}"] = {
+            "points": msm_t,
+            "devices": sm.n_shards,
+            "compile_plus_first_s": round(compile_s, 1),
+            "warm_s": round(dt, 2),
+            "points_per_sec": round(msm_t / dt, 1),
+            "deterministic": ok,
+        }
+        _mark(f"ladder msm{msm_t}: warm {dt:.2f}s ({msm_t / dt:,.0f} points/s)")
+        emit()
+    elif msm_t > 0:
+        _mark(f"skipping ladder msm{msm_t} (only {left():.0f}s left)")
+
+    # -- Pallas-vs-XLA field-mul microbench (SURVEY §2a evidence; guarded:
+    # a Mosaic lowering failure must never cost the headline number)
+    if os.environ.get("DAGRIDER_BENCH_PALLAS", "1") == "1" and left() > 60:
+        try:
+            _mark("pallas probe: compiling field-mul chains (xla + pallas)")
+            from dag_rider_tpu.ops import pallas_field
+
+            xla_ms, pallas_ms, same = pallas_field.benchmark_vs_xla()
+            result["phases"]["pallas_field_mul"] = {
+                "batch": 8192,
+                "chain": 64,
+                "xla_ms": round(xla_ms, 2),
+                "pallas_ms": round(pallas_ms, 2),
+                "bit_identical": same,
+                "speedup": round(xla_ms / pallas_ms, 2) if pallas_ms else None,
+            }
+            _mark(
+                f"pallas probe: xla {xla_ms:.1f}ms vs pallas {pallas_ms:.1f}ms"
+                f" (identical={same})"
+            )
+            emit()
+        except Exception as e:  # noqa: BLE001 — evidence phase is best-effort
+            result["phases"]["pallas_field_mul"] = {"error": repr(e)[:200]}
+            _mark(f"pallas probe FAILED (non-fatal): {e!r}")
+            emit()
     _mark("measure: done")
     emit()
 
@@ -445,8 +513,11 @@ def main() -> None:
         env["DAGRIDER_BENCH_SECONDS"] = str(cpu_timeout - 15.0)
         env["DAGRIDER_BENCH_N256_MIN"] = "10000"  # skip n=256 on CPU
         # One 64-node consensus chunk costs ~a minute of CPU verify
-        # dispatches — the sim rung is TPU-only.
+        # dispatches, and the T=1024 MSM runs ~70s/warm-run on CPU —
+        # both rungs are TPU-only.
         env["DAGRIDER_BENCH_SIM_S"] = "0"
+        env["DAGRIDER_BENCH_MSM_T"] = "0"
+        env["DAGRIDER_BENCH_PALLAS"] = "0"  # Mosaic needs the real chip
         _mark(f"outer: CPU fallback (timeout {cpu_timeout:.0f}s)")
         result, ctail = _run_stage("measure", env, cpu_timeout)
         if result is None:

@@ -114,8 +114,13 @@ def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
     so the HLO stays one window long regardless of scalar size.
     """
     # Radix-16 table via scan: one padd body in the HLO instead of 14
-    # inlined ones (compile-time win; identical values).
-    ident = identity(nibbles.shape[:-1])
+    # inlined ones (compile-time win; identical values). The identity is
+    # derived from ``p`` (0*X, 0*Y + 1, 0*Z) rather than broadcast from
+    # constants so that under shard_map the scan/fori carries inherit the
+    # batch axis's "varying" type from the inputs (shard_map rejects an
+    # unvarying carry that becomes varying after one body application).
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), p[1].shape)
+    ident = (jnp.zeros_like(p[0]), jnp.zeros_like(p[1]) + one, jnp.zeros_like(p[2]))
 
     def _entry(prev, _):
         nxt = padd(prev, p)
@@ -134,7 +139,27 @@ def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
         idx = jnp.take(nibbles, WINDOWS - 1 - i, axis=-1)
         return padd(acc, _gather_entry(table, idx))
 
-    return jax.lax.fori_loop(0, WINDOWS, body, identity(nibbles.shape[:-1]))
+    return jax.lax.fori_loop(0, WINDOWS, body, ident)
+
+
+def tree_reduce(acc: Point) -> Point:
+    """Pairwise-fold a [t, ...] point batch to [1, ...] — any t >= 1
+    (odd counts carry their last element into the next level)."""
+    t = acc[0].shape[0]
+    while t > 1:
+        half = t // 2
+        folded = padd(
+            tuple(c[:half] for c in acc),
+            tuple(c[half : 2 * half] for c in acc),
+        )
+        if t % 2:
+            folded = tuple(
+                jnp.concatenate([fc, c[2 * half :]], axis=0)
+                for fc, c in zip(folded, acc)
+            )
+        acc = folded
+        t = half + t % 2
+    return acc
 
 
 @jax.jit
@@ -147,14 +172,7 @@ def msm_kernel(
     (maps to the identity). Returns one projective point (X, Y, Z) [33].
     """
     acc = scalar_mul(nibbles, (px, py, pz))  # [T, 33] each — vmapped walk
-    # pairwise tree reduction over the point axis (T is a power of two)
-    t = px.shape[0]
-    while t > 1:
-        t //= 2
-        acc = padd(
-            tuple(c[:t] for c in acc), tuple(c[t : 2 * t] for c in acc)
-        )
-    return tuple(c[0] for c in acc)
+    return tuple(c[0] for c in tree_reduce(acc))
 
 
 # ---------------------------------------------------------------------------
@@ -169,11 +187,50 @@ def _nibbles(k: int) -> np.ndarray:
     return out
 
 
-def _pad(n: int) -> int:
-    t = 4
+def _pad(n: int, base: int = 4) -> int:
+    """Smallest base * 2^k >= max(n, base) — the padded batch size."""
+    t = base
     while t < n:
         t *= 2
     return t
+
+
+def pack_inputs(
+    scalars: Sequence[int], points: Sequence[tuple], t: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Marshal host (scalar, affine point) pairs into padded kernel inputs.
+
+    Pad slots (and None points) become the identity (0 : 1 : 0) with
+    scalar 0; scalars are reduced mod r. Shared by the single-device
+    :func:`msm` and the mesh-sharded ``parallel.msm.ShardedMSM`` so the
+    crypto-sensitive marshalling lives exactly once.
+    """
+    if len(scalars) != len(points):
+        raise ValueError("scalars/points length mismatch")
+    nib = np.zeros((t, WINDOWS), dtype=np.int32)
+    px = np.zeros((t, F.LIMBS), dtype=np.int32)
+    py = np.zeros((t, F.LIMBS), dtype=np.int32)
+    pz = np.zeros((t, F.LIMBS), dtype=np.int32)
+    py[:] = F.ONE
+    for i, (k, pt) in enumerate(zip(scalars, points)):
+        if pt is None:
+            continue  # identity contributes nothing regardless of scalar
+        nib[i] = _nibbles(k % R_INT)
+        px[i] = F.to_limbs(pt[0])
+        py[i] = F.to_limbs(pt[1])
+        pz[i] = F.ONE
+    return nib, px, py, pz
+
+
+def unpack_point(X, Y, Z) -> Optional[tuple]:
+    """Projective limb point -> host affine (x, y) tuple (None: identity)."""
+    xi = F.from_limbs(np.asarray(F.canonical(X)))
+    yi = F.from_limbs(np.asarray(F.canonical(Y)))
+    zi = F.from_limbs(np.asarray(F.canonical(Z)))
+    if zi == 0:
+        return None
+    z_inv = pow(zi, P_INT - 2, P_INT)
+    return (xi * z_inv % P_INT, yi * z_inv % P_INT)
 
 
 def msm(scalars: Sequence[int], points: Sequence[tuple]) -> Optional[tuple]:
@@ -187,28 +244,8 @@ def msm(scalars: Sequence[int], points: Sequence[tuple]) -> Optional[tuple]:
 
     Returns an affine (x, y) tuple, or None for the identity.
     """
-    if len(scalars) != len(points):
-        raise ValueError("scalars/points length mismatch")
-    t = _pad(len(points))
-    nib = np.zeros((t, WINDOWS), dtype=np.int32)
-    px = np.zeros((t, F.LIMBS), dtype=np.int32)
-    py = np.zeros((t, F.LIMBS), dtype=np.int32)
-    pz = np.zeros((t, F.LIMBS), dtype=np.int32)
-    py[:] = F.ONE  # pad slots: identity (0 : 1 : 0) with scalar 0
-    for i, (k, pt) in enumerate(zip(scalars, points)):
-        if pt is None:
-            continue  # identity contributes nothing regardless of scalar
-        nib[i] = _nibbles(k % R_INT)
-        px[i] = F.to_limbs(pt[0])
-        py[i] = F.to_limbs(pt[1])
-        pz[i] = F.ONE
+    nib, px, py, pz = pack_inputs(scalars, points, _pad(len(points)))
     X, Y, Z = msm_kernel(
         jnp.asarray(nib), jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)
     )
-    xi = F.from_limbs(np.asarray(F.canonical(X)))
-    yi = F.from_limbs(np.asarray(F.canonical(Y)))
-    zi = F.from_limbs(np.asarray(F.canonical(Z)))
-    if zi == 0:
-        return None
-    z_inv = pow(zi, P_INT - 2, P_INT)
-    return (xi * z_inv % P_INT, yi * z_inv % P_INT)
+    return unpack_point(X, Y, Z)

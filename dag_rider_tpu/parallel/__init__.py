@@ -2,6 +2,7 @@
 sharded round step (SURVEY.md §2b/§2c build mapping)."""
 
 from dag_rider_tpu.parallel.mesh import make_mesh, batch_sharding
+from dag_rider_tpu.parallel.msm import ShardedMSM
 from dag_rider_tpu.parallel.sharded_verifier import ShardedTPUVerifier
 
-__all__ = ["make_mesh", "batch_sharding", "ShardedTPUVerifier"]
+__all__ = ["make_mesh", "batch_sharding", "ShardedMSM", "ShardedTPUVerifier"]

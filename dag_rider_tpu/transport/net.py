@@ -127,15 +127,19 @@ class GrpcTransport(Transport):
             self._inbox.append(msg)
 
     def _stub(self, peer: int):
-        if peer not in self._stubs:
-            chan = grpc.insecure_channel(self._peers[peer])
-            self._channels[peer] = chan
-            self._stubs[peer] = chan.unary_unary(
-                _METHOD,
-                request_serializer=_identity,
-                response_deserializer=_identity,
-            )
-        return self._stubs[peer]
+        # Called from the owner thread AND retry-timer threads: channel
+        # creation must be locked or two threads can race a first send to
+        # the same peer and leak the losing channel.
+        with self._lock:
+            if peer not in self._stubs:
+                chan = grpc.insecure_channel(self._peers[peer])
+                self._channels[peer] = chan
+                self._stubs[peer] = chan.unary_unary(
+                    _METHOD,
+                    request_serializer=_identity,
+                    response_deserializer=_identity,
+                )
+            return self._stubs[peer]
 
     # -- Transport interface -------------------------------------------------
 
@@ -239,5 +243,7 @@ class GrpcTransport(Transport):
         for t in list(self._timers):
             t.cancel()
         self._server.stop(grace=None)
-        for chan in self._channels.values():
+        with self._lock:
+            channels = list(self._channels.values())
+        for chan in channels:
             chan.close()

@@ -138,3 +138,34 @@ def test_round_step_kernel_matches_unfused_kernels(mesh):
     host_votes = reach[:, 0] & np.asarray(exists_r4)
     assert (np.asarray(votes) == host_votes).all()
     assert bool(commit) == (int(host_votes.sum()) >= 3)
+
+
+# ----------------------------------------------------------------------
+# Mesh-sharded MSM (BASELINE rung #5; round-2 VERDICT next #9)
+# ----------------------------------------------------------------------
+
+
+def test_sharded_msm_matches_host_oracle(mesh):
+    import random
+
+    from dag_rider_tpu.crypto import bls12381 as bls
+    from dag_rider_tpu.parallel.msm import ShardedMSM
+
+    rng = random.Random(9)
+    t = 32  # 4 points/device on the 8-device mesh; T=1024 is the bench's
+    pts = [bls.g1_mul(rng.randrange(1, bls.R)) for _ in range(t)]
+    ks = [rng.randrange(0, bls.R) for _ in range(t)]
+    ks[5] = 0
+    pts[7] = None  # identity slots must drop out
+    want = bls.g1_msm(ks, pts)
+    sm = ShardedMSM(mesh)
+    assert sm(ks, pts) == want
+    # plugs into the aggregate seam
+    from dag_rider_tpu.crypto import threshold as th
+
+    keys = th.ThresholdKeys.generate(8, 3)
+    shares = {i: th.sign_share(keys.share_sks[i], 2) for i in range(4)}
+    sigma_dev = th.aggregate(shares, 3, msm=sm)
+    sigma_host = th.aggregate(shares, 3)
+    assert sigma_dev == sigma_host
+    assert th.verify_group(keys.group_pk, 2, sigma_dev)
