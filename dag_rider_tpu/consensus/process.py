@@ -46,6 +46,7 @@ from dag_rider_tpu.core.stack import Stack
 from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
 from dag_rider_tpu.transport.base import Transport
 from dag_rider_tpu.utils.metrics import Metrics, Timer
+from dag_rider_tpu.utils.slog import NOOP, EventLog
 
 # a_deliver callback: (vertex) — the client-facing output of Algorithm 1.
 DeliverCallback = Callable[[Vertex], None]
@@ -64,6 +65,7 @@ class Process:
         verifier=None,
         signer=None,
         on_deliver: Optional[DeliverCallback] = None,
+        log: EventLog = NOOP,
     ) -> None:
         if not 0 <= index < cfg.n:
             raise ValueError(f"index must be in [0, {cfg.n}), got {index}")
@@ -74,6 +76,10 @@ class Process:
         self.verifier = verifier
         self.signer = signer
         self.on_deliver = on_deliver
+        # Structured event log (SURVEY §5 L5; the reference has 3 zap
+        # Debug sites — here every state transition emits a typed event).
+        # NOOP by default: one attribute test per call site.
+        self.log = log.child(process=index) if log.enabled else log
 
         self.dag = DagState(cfg)
         # Genesis: the predefined round-0 vertex set, one per source (D2
@@ -159,6 +165,9 @@ class Process:
             or v.id.round < 1
         ):
             self.metrics.inc("msgs_rejected_stamp")
+            self.log.event(
+                "reject_stamp", round=msg.round, sender=msg.sender
+            )
             return
         if (
             self.dag.present(v.id)
@@ -169,6 +178,9 @@ class Process:
             if prev is not None and prev != v.digest():
                 # same (round, source), different content — equivocation.
                 self.metrics.inc("equivocations_detected")
+                self.log.event(
+                    "equivocation", round=v.round, source=v.source
+                )
             else:
                 self.metrics.inc("msgs_duplicate")
             return
@@ -189,6 +201,13 @@ class Process:
             )
         ):
             self.metrics.inc("msgs_rejected_edges")
+            self.log.event(
+                "reject_edges",
+                round=v.round,
+                source=v.source,
+                strong=len(v.strong_edges),
+                weak=len(v.weak_edges),
+            )
             return
         self._seen_digests[v.id] = v.digest()
         if self.verifier is not None:
@@ -224,6 +243,9 @@ class Process:
                 self._admit_to_buffer(v)
             else:
                 self.metrics.inc("msgs_rejected_signature")
+                self.log.event(
+                    "reject_signature", round=v.round, source=v.source
+                )
 
     # ------------------------------------------------------------------
     # The progress engine (Algorithm 2 lines 5-15)
@@ -274,6 +296,9 @@ class Process:
                     self.dag.insert(v)
                     self._buffered_ids.discard(v.id)
                     self.metrics.inc("vertices_admitted")
+                    self.log.event(
+                        "admit", round=v.round, source=v.source
+                    )
                     changed = True
                     admitted_any = True
                 else:
@@ -301,6 +326,7 @@ class Process:
                 break  # paper: wait until a block is available
             self.round += 1
             self.metrics.inc("rounds_advanced")
+            self.log.event("round_advance", round=self.round)
             v = self._create_vertex(self.round)
             self.dag.insert(v)
             self._seen_digests[v.id] = v.digest()
@@ -400,15 +426,20 @@ class Process:
             return
         if not self.coin.ready(wave):
             self._pending_waves.add(wave)
+            self.log.event("wave_pending_coin", wave=wave)
             return
         leader = self._wave_leader(wave)
         if leader is None:
             self.metrics.inc("waves_skipped")
+            self.log.event("wave_skip", wave=wave, reason="no_leader")
             return
         r4, r1 = self.cfg.wave_round(wave, self.cfg.wave_length), self.cfg.wave_round(wave, 1)
         votes = self._strong_reach_count(r4, r1, leader.source)
         if votes < self.cfg.quorum:
             self.metrics.inc("waves_skipped")
+            self.log.event(
+                "wave_skip", wave=wave, reason="quorum", votes=votes
+            )
             return
         # Retroactive leader chain (process.go:341-350): walk back through
         # undecided waves, committing every prior leader the current one
@@ -426,6 +457,13 @@ class Process:
                     cur = prior
             self.decided_wave = wave
             self.metrics.inc("waves_decided")
+            self.log.event(
+                "wave_decided",
+                wave=wave,
+                leader=leader.source,
+                votes=votes,
+                chain=len(leaders),
+            )
             self._order_vertices(leaders)
         self.metrics.observe_wave_commit(t.seconds)
 
@@ -454,6 +492,7 @@ class Process:
         leader's causal history, oldest leader first (D5/D6/D8 fixed: it
         runs, it calls the client callback, and delivered vertices are
         skipped exactly once)."""
+        n_before = len(self.delivered_log)
         while not leaders.is_empty():
             leader = leaders.pop()
             reached = self.dag.closure([leader.id], strong_only=False)
@@ -467,3 +506,8 @@ class Process:
                     self.metrics.inc("vertices_delivered")
                     if self.on_deliver is not None:
                         self.on_deliver(self.dag.vertices[vid])
+        self.log.event(
+            "delivered",
+            count=len(self.delivered_log) - n_before,
+            total=len(self.delivered_log),
+        )

@@ -18,6 +18,7 @@ from dag_rider_tpu.consensus.process import Process
 from dag_rider_tpu.core.types import Block, Vertex
 from dag_rider_tpu.transport.base import Transport
 from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.utils.slog import NOOP
 
 
 class Simulation:
@@ -32,6 +33,7 @@ class Simulation:
         verifier_factory: Optional[Callable[[int], object]] = None,
         signer_factory: Optional[Callable[[int], object]] = None,
         rbc: bool = False,
+        log=None,
     ) -> None:
         self.cfg = cfg
         self.transport = transport if transport is not None else InMemoryTransport()
@@ -56,6 +58,7 @@ class Simulation:
                     verifier=verifier_factory(i) if verifier_factory else None,
                     signer=signer_factory(i) if signer_factory else None,
                     on_deliver=sink.append,
+                    log=log if log is not None else NOOP,
                 )
             )
 

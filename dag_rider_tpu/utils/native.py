@@ -1,0 +1,126 @@
+"""ctypes seam to the native host library (native/challenge.cpp).
+
+The build's native surface (SURVEY §2a: host-side native code in C++):
+batched Ed25519 challenge-scalar computation for the verify host path.
+The library is compiled on demand with ``g++ -O2 -shared -fPIC`` into the
+package's ``native/`` directory and loaded with ctypes — no pybind11 /
+build-system dependency. Everything degrades to the pure-Python hashlib
+path when the toolchain or the compiled object is unavailable, and the
+hashlib path remains the differential-testing oracle
+(tests/test_native.py asserts byte-identical outputs).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_DIR, "challenge.cpp")
+_SO = os.path.join(_DIR, "libdagrider_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile to a temp file, then atomically rename into place — two
+    processes racing a cold/stale cache must never load a half-written
+    object."""
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    try:
+        proc = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            capture_output=True,
+            timeout=120,
+        )
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _stale() -> bool:
+    try:
+        if not os.path.exists(_SO):
+            return True
+        # No source in the deployment (prebuilt-only): use the .so as-is.
+        if not os.path.exists(_SRC):
+            return False
+        return os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    except OSError:
+        return True
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable.
+    Never raises — every failure degrades to the pure-Python path."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if _stale() and not _build():
+                return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.dagrider_challenge_batch.argtypes = [
+            u8p, u8p, u8p, u64p, ctypes.c_uint64, u8p,
+        ]
+        lib.dagrider_challenge_batch.restype = None
+        _lib = lib
+        return _lib
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def challenge_batch(
+    rs: np.ndarray, pks: np.ndarray, msgs: Sequence[bytes]
+) -> Optional[np.ndarray]:
+    """k_i = SHA-512(R_i || A_i || M_i) mod L for the whole batch.
+
+    rs/pks: uint8[n, 32]; msgs: n byte strings. Returns uint8[n, 32]
+    little-endian scalars, or None when the native library is absent.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n = len(msgs)
+    if rs.shape != (n, 32) or pks.shape != (n, 32):
+        raise ValueError("rs/pks must be uint8[n, 32]")
+    rs = np.ascontiguousarray(rs, dtype=np.uint8)
+    pks = np.ascontiguousarray(pks, dtype=np.uint8)
+    blob = b"".join(msgs)
+    offs = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum([len(m) for m in msgs], out=offs[1:])
+    data = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(1, dtype=np.uint8)
+    out = np.zeros((n, 32), dtype=np.uint8)
+    lib.dagrider_challenge_batch(
+        _u8(rs),
+        _u8(pks),
+        _u8(data),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.c_uint64(n),
+        _u8(out),
+    )
+    return out

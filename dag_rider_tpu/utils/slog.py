@@ -1,0 +1,72 @@
+"""Structured event logging — the L5 observability layer.
+
+The reference logs three Debug lines through charon's zap wrapper
+(``process/process.go:109,213,220``); SURVEY §5 asks the build to do
+better. This is a structured *event* log: named events with key-value
+fields and per-logger context (process index, node name), with a
+pluggable sink so the same call sites serve tests (capture list), CLI
+runs (stdlib logging), and production (anything that accepts one dict).
+
+Zero cost when disabled: the default sink is None and ``event()`` is a
+single attribute test — consensus hot loops can log unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+# A sink receives one flat dict per event.
+Sink = Callable[[Dict[str, object]], None]
+
+
+class EventLog:
+    """Named events + bound context, fanned into one sink."""
+
+    __slots__ = ("sink", "context")
+
+    def __init__(self, sink: Optional[Sink] = None, **context: object):
+        self.sink = sink
+        self.context = context
+
+    def event(self, name: str, **fields: object) -> None:
+        if self.sink is None:
+            return
+        rec: Dict[str, object] = {"event": name, "ts": time.time()}
+        rec.update(self.context)
+        rec.update(fields)
+        self.sink(rec)
+
+    def child(self, **context: object) -> "EventLog":
+        """Same sink, extended context (e.g. per-process index)."""
+        merged = dict(self.context)
+        merged.update(context)
+        return EventLog(self.sink, **merged)
+
+    @property
+    def enabled(self) -> bool:
+        return self.sink is not None
+
+
+#: Shared disabled logger — the default for every component.
+NOOP = EventLog()
+
+
+def capture() -> tuple:
+    """(log, records): an EventLog whose events append to ``records``."""
+    records: List[Dict[str, object]] = []
+    return EventLog(records.append), records
+
+
+def stdlib_sink(
+    logger: Optional[logging.Logger] = None, level: int = logging.DEBUG
+) -> Sink:
+    """Bridge into stdlib logging: one JSON line per event."""
+    lg = logger if logger is not None else logging.getLogger("dag_rider_tpu")
+
+    def sink(rec: Dict[str, object]) -> None:
+        lg.log(level, "%s", json.dumps(rec, default=repr, sort_keys=True))
+
+    return sink

@@ -40,6 +40,14 @@ from dag_rider_tpu.verifier.base import KeyRegistry, Verifier
 _MIN_BUCKET = 16
 
 
+def _native_enabled() -> bool:
+    """Native challenge hashing on by default; DAGRIDER_NATIVE=0 disables
+    (the hashlib fallback is always available)."""
+    import os
+
+    return os.environ.get("DAGRIDER_NATIVE", "1") == "1"
+
+
 def _bucket(n: int) -> int:
     b = _MIN_BUCKET
     while b < n:
@@ -156,24 +164,22 @@ class TPUVerifier(Verifier):
         # r_y < p canonicity compare are batched numpy; only the SHA-512
         # challenge hashing walks the batch (variable-length messages).
         sig_raw = np.zeros((size, 64), dtype=np.uint8)
+        pk_raw = np.zeros((size, 32), dtype=np.uint8)
         k_raw = np.zeros((size, 32), dtype=np.uint8)
         src = np.zeros(size, dtype=np.int64)
         structural = np.zeros(size, dtype=bool)
-        digests = []
+        msgs = []
         for j, v in enumerate(vertices):
             pk = self.registry.key_of(v.source)
             sig = v.signature
             if pk is None or sig is None or len(sig) != 64 or len(pk) != 32:
-                digests.append(None)
+                msgs.append(b"")
                 continue
             sig_raw[j] = np.frombuffer(sig, dtype=np.uint8)
+            pk_raw[j] = np.frombuffer(pk, dtype=np.uint8)
             src[j] = v.source
             structural[j] = True
-            # SHA-512(R || A || M) — the challenge hash; mod L and nibble
-            # split happen vectorized below.
-            digests.append(
-                hashlib.sha512(sig[:32] + pk + v.signing_bytes()).digest()
-            )
+            msgs.append(v.signing_bytes())
         s_raw = sig_raw[:, 32:]
         r_raw = sig_raw[:, :32].copy()
         # s < L, batched: big-endian lexicographic compare against L.
@@ -183,11 +189,37 @@ class TPUVerifier(Verifier):
         r_raw[:, 31] &= 0x7F
         r_lt_p = _lex_lt(r_raw, _P_BYTES_LE)
         prevalid = structural & s_lt_l & r_lt_p
-        # k = SHA-512 digest mod L per valid row (python-int modmul is the
-        # only per-row work left; ~1 us/row).
-        for j in np.flatnonzero(prevalid):
-            k = int.from_bytes(digests[j], "little") % ed25519.L
-            k_raw[j] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+        # k = SHA-512(R || A || M) mod L per valid row — one native C++
+        # batch call when the library is available (utils/native.py;
+        # differential-tested against the hashlib path, which remains the
+        # fallback and oracle).
+        idx = np.flatnonzero(prevalid)
+        if len(idx):
+            k_rows = None
+            if _native_enabled():
+                from dag_rider_tpu.utils import native
+
+                k_rows = native.challenge_batch(
+                    sig_raw[idx, :32], pk_raw[idx], [msgs[j] for j in idx]
+                )
+            if k_rows is not None:
+                k_raw[idx] = k_rows
+            else:
+                for j in idx:
+                    k = (
+                        int.from_bytes(
+                            hashlib.sha512(
+                                sig_raw[j, :32].tobytes()
+                                + pk_raw[j].tobytes()
+                                + msgs[j]
+                            ).digest(),
+                            "little",
+                        )
+                        % ed25519.L
+                    )
+                    k_raw[j] = np.frombuffer(
+                        k.to_bytes(32, "little"), dtype=np.uint8
+                    )
         s_nib = nibbles_batch(np.where(prevalid[:, None], s_raw, 0))
         k_nib = nibbles_batch(k_raw)
         r_y_limbs = bytes_to_limbs_batch(r_raw)

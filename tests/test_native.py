@@ -1,0 +1,89 @@
+"""Native C++ host component vs the pure-Python oracle.
+
+SURVEY §2a: host-side native code in C++ where the runtime needs it. The
+challenge-scalar batch (SHA-512(R||A||M) mod L) is the verify host path's
+last per-row loop; the native path must be byte-identical to hashlib and
+the verifier must produce identical masks with it on or off.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from dag_rider_tpu.crypto import ed25519
+from dag_rider_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native toolchain unavailable"
+)
+
+
+def test_challenge_batch_matches_hashlib():
+    rng = np.random.default_rng(0)
+    n = 300
+    rs = rng.integers(0, 256, size=(n, 32)).astype(np.uint8)
+    pks = rng.integers(0, 256, size=(n, 32)).astype(np.uint8)
+    msgs = [
+        rng.integers(0, 256, size=int(ln)).astype(np.uint8).tobytes()
+        for ln in rng.integers(0, 400, size=n)
+    ]
+    out = native.challenge_batch(rs, pks, msgs)
+    for i in range(n):
+        k = (
+            int.from_bytes(
+                hashlib.sha512(
+                    rs[i].tobytes() + pks[i].tobytes() + msgs[i]
+                ).digest(),
+                "little",
+            )
+            % ed25519.L
+        )
+        assert out[i].tobytes() == k.to_bytes(32, "little"), f"row {i}"
+
+
+def test_challenge_batch_extreme_digests():
+    """Rows engineered near the reduction's edge: all-0xFF digest inputs
+    and empty messages."""
+    rs = np.full((4, 32), 0xFF, dtype=np.uint8)
+    pks = np.full((4, 32), 0xFF, dtype=np.uint8)
+    msgs = [b"", b"\xff" * 500, b"\x00", b"x" * 127]
+    out = native.challenge_batch(rs, pks, msgs)
+    for i in range(4):
+        k = (
+            int.from_bytes(
+                hashlib.sha512(
+                    rs[i].tobytes() + pks[i].tobytes() + msgs[i]
+                ).digest(),
+                "little",
+            )
+            % ed25519.L
+        )
+        assert out[i].tobytes() == k.to_bytes(32, "little")
+
+
+def test_verifier_masks_identical_native_on_off(monkeypatch):
+    import dataclasses
+
+    from dag_rider_tpu.core.types import Block, Vertex, VertexID
+    from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+    from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+    reg, seeds = KeyRegistry.generate(4)
+    signers = [VertexSigner(s) for s in seeds]
+    vs = []
+    for i in range(4):
+        v = Vertex(
+            id=VertexID(1, i),
+            block=Block((f"tx{i}".encode(),)),
+            strong_edges=(VertexID(0, 0), VertexID(0, 1), VertexID(0, 2)),
+        )
+        vs.append(signers[i].sign_vertex(v))
+    vs.append(dataclasses.replace(vs[1], signature=b"\x11" * 64))
+    ver = TPUVerifier(reg)
+    monkeypatch.setenv("DAGRIDER_NATIVE", "1")
+    with_native = ver.verify_batch(vs)
+    monkeypatch.setenv("DAGRIDER_NATIVE", "0")
+    without = ver.verify_batch(vs)
+    assert with_native == without == [True, True, True, True, False]

@@ -1,0 +1,61 @@
+"""Structured event log + its Process wiring (SURVEY §5 L5 layer)."""
+
+import json
+import logging
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.process import Process
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core.types import BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.utils.slog import EventLog, NOOP, capture, stdlib_sink
+
+
+def test_noop_log_is_disabled_and_cheap():
+    assert not NOOP.enabled
+    NOOP.event("anything", x=1)  # must not raise, must not allocate a sink
+
+
+def test_capture_records_context_and_fields():
+    log, records = capture()
+    child = log.child(process=3)
+    child.event("admit", round=2, source=1)
+    assert records[0]["event"] == "admit"
+    assert records[0]["process"] == 3
+    assert records[0]["round"] == 2
+    assert "ts" in records[0]
+
+
+def test_stdlib_sink_emits_json_lines(caplog):
+    logger = logging.getLogger("test-dagrider-slog")
+    log = EventLog(stdlib_sink(logger), node="n0")
+    with caplog.at_level(logging.DEBUG, logger="test-dagrider-slog"):
+        log.event("wave_decided", wave=4)
+    rec = json.loads(caplog.records[0].getMessage())
+    assert rec["event"] == "wave_decided" and rec["node"] == "n0"
+
+
+def test_process_emits_lifecycle_events():
+    log, records = capture()
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    sim = Simulation(cfg, log=log)
+    sim.submit_blocks(per_process=10)
+    sim.run(max_messages=20_000)
+    names = {r["event"] for r in records}
+    assert {"round_advance", "admit", "wave_decided", "delivered"} <= names
+    decided = [r for r in records if r["event"] == "wave_decided"]
+    assert all("leader" in r and "votes" in r and "process" in r for r in decided)
+
+
+def test_process_logs_rejections():
+    log, records = capture()
+    cfg = Config(n=4, coin="round_robin")
+    p = Process(cfg, 0, InMemoryTransport(), log=log)
+    # stamp mismatch
+    v = Vertex(id=VertexID(1, 1), strong_edges=tuple(VertexID(0, s) for s in range(3)))
+    p.on_message(BroadcastMessage(vertex=v, round=2, sender=1))
+    # bad edges
+    bad = Vertex(id=VertexID(1, 2), strong_edges=(VertexID(0, 0),))
+    p.on_message(BroadcastMessage(vertex=bad, round=1, sender=2))
+    names = [r["event"] for r in records]
+    assert "reject_stamp" in names and "reject_edges" in names
