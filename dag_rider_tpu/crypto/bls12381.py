@@ -692,6 +692,47 @@ def _ec_mul_raw(ops: _Ops, k: int, p1):
     return _jac_to_affine(ops, acc, zero)
 
 
+def g2_serialize(p1) -> bytes:
+    """192-byte uncompressed affine G2 point (internal key-file format:
+    x.c1 || x.c0 || y.c1 || y.c0, big-endian 48-byte Fp each; identity is
+    all-zero). Uncompressed by choice — decompression would need an Fp2
+    square root, and public keys live in our own key files, not on the
+    wire."""
+    if p1 is None:
+        return bytes(192)
+    (x0, x1), (y0, y1) = p1
+    return (
+        x1.to_bytes(48, "big")
+        + x0.to_bytes(48, "big")
+        + y1.to_bytes(48, "big")
+        + y0.to_bytes(48, "big")
+    )
+
+
+def g2_deserialize(data: bytes):
+    """Inverse of g2_serialize; validates field range and curve membership.
+    Returns None for the identity encoding; raises ValueError on junk."""
+    if len(data) != 192:
+        raise ValueError("G2 point must be 192 bytes")
+    if data == bytes(192):
+        return None
+    vals = [int.from_bytes(data[i * 48 : (i + 1) * 48], "big") for i in range(4)]
+    if any(v >= P for v in vals):
+        raise ValueError("G2 coordinate out of field range")
+    x1, x0, y1, y0 = vals
+    pt = ((x0, x1), (y0, y1))
+    if not g2_on_curve(pt):
+        raise ValueError("point not on the G2 curve")
+    # r-order subgroup check: the twist's cofactor is huge, and a
+    # non-subgroup "public key" would silently corrupt pairing-based
+    # share verification (small-subgroup structure) instead of failing
+    # loudly here. [r]P must be the identity. (_ec_mul reduces mod r, so
+    # the raw ladder is required.)
+    if _ec_mul_raw(_FP2_OPS, R, pt) is not None:
+        raise ValueError("G2 point not in the r-order subgroup")
+    return pt
+
+
 # --- BLS signatures (minimal-signature-size: sig in G1, pk in G2) ----------
 
 

@@ -31,8 +31,11 @@ sha512_fn resolve_openssl_sha512() {
   static bool tried = false;
   if (!tried) {
     tried = true;
-    void* h = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
-    if (!h) h = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    // RTLD_LOCAL: we only dlsym from our own handle; exporting OpenSSL
+    // symbols globally could interpose on a different libcrypto already
+    // loaded by Python's _ssl/cryptography modules.
+    void* h = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (!h) h = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_LOCAL);
     if (h) cached = (sha512_fn)dlsym(h, "SHA512");
   }
   return cached;

@@ -1,0 +1,252 @@
+"""Runnable committee node — ``python -m dag_rider_tpu.node``.
+
+The reference is a library with no main package (SURVEY §3); a framework
+needs a deployment shape. This wires the full stack for one participant:
+gRPC transport (+ optional Bracha RBC), the batched device Verifier,
+Ed25519 vertex signing, the threshold-BLS (or round-robin) coin, periodic
+checkpointing, and structured logs — all from one JSON config.
+
+Subcommands:
+
+- ``keygen --n 4 --threshold 2 --out keys.json`` — dealer-style committee
+  key material: Ed25519 registry + per-node seeds, threshold-BLS shares.
+  (Deterministic dealer = test/deploy convenience; a production committee
+  would run a DKG so nobody ever holds the group secret.)
+- ``run --config node0.json`` — start one node and pump until stopped.
+
+Config (JSON):
+{
+  "index": 0, "n": 4, "listen": "127.0.0.1:7000",
+  "peers": {"1": "127.0.0.1:7001", ...},
+  "keys": "keys.json",            // from keygen
+  "rbc": true,                     // Bracha reliable broadcast stage
+  "verifier": "device",            // "device" | "cpu" | "none"
+  "coin": "threshold_bls",         // | "round_robin" | "fixed"
+  "checkpoint_dir": "ckpt/node0",  // optional, periodic + on shutdown
+  "checkpoint_every_s": 30,
+  "submit_interval_s": 0.5         // synthetic client load (0: none)
+}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.coin import FixedCoin, RoundRobinCoin, ThresholdCoin
+from dag_rider_tpu.consensus.process import Process
+from dag_rider_tpu.core.types import Block
+from dag_rider_tpu.crypto import threshold as th
+from dag_rider_tpu.transport.net import GrpcTransport
+from dag_rider_tpu.transport.rbc import RbcTransport
+from dag_rider_tpu.utils import checkpoint
+from dag_rider_tpu.utils.slog import EventLog, NOOP, stdlib_sink
+from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+
+
+# ----------------------------------------------------------------------
+# keygen
+# ----------------------------------------------------------------------
+
+def generate_keys(n: int, threshold: int, seed: str = "dagrider-committee") -> dict:
+    """Committee key material as one JSON-serializable dict."""
+    reg, seeds = KeyRegistry.generate(n, seed_prefix=seed.encode() + b"|ed|")
+    coin_keys = th.ThresholdKeys.generate(n, threshold, seed=seed.encode())
+    from dag_rider_tpu.crypto import bls12381 as bls
+
+    return {
+        "n": n,
+        "threshold": threshold,
+        "ed25519_public": [pk.hex() for pk in reg.public_keys],
+        "ed25519_seeds": [s.hex() for s in seeds],
+        "bls_group_pk": bls.g2_serialize(coin_keys.group_pk).hex(),
+        "bls_share_pks": [
+            bls.g2_serialize(pk).hex() for pk in coin_keys.share_pks
+        ],
+        "bls_share_sks": [hex(sk) for sk in coin_keys.share_sks],
+    }
+
+
+def load_keys(blob: dict):
+    """(KeyRegistry, seeds, ThresholdKeys) from a keygen dict."""
+    from dag_rider_tpu.crypto import bls12381 as bls
+
+    reg = KeyRegistry(tuple(bytes.fromhex(pk) for pk in blob["ed25519_public"]))
+    seeds = [bytes.fromhex(s) for s in blob["ed25519_seeds"]]
+    coin_keys = th.ThresholdKeys(
+        blob["threshold"],
+        bls.g2_deserialize(bytes.fromhex(blob["bls_group_pk"])),
+        [bls.g2_deserialize(bytes.fromhex(p)) for p in blob["bls_share_pks"]],
+        [int(sk, 16) for sk in blob["bls_share_sks"]],
+    )
+    return reg, seeds, coin_keys
+
+
+# ----------------------------------------------------------------------
+# node
+# ----------------------------------------------------------------------
+
+class Node:
+    """One running participant; owns the pump thread."""
+
+    def __init__(self, cfg: dict, *, log: Optional[EventLog] = None):
+        n = int(cfg["n"])
+        index = int(cfg["index"])
+        self.ccfg = Config(
+            n=n,
+            coin=cfg.get("coin", "round_robin"),
+            propose_empty=bool(cfg.get("propose_empty", True)),
+        )
+        with open(cfg["keys"]) as fh:
+            reg, seeds, coin_keys = load_keys(json.load(fh))
+        if reg.n != n:
+            raise ValueError(f"keys are for n={reg.n}, config says n={n}")
+
+        self.log = log if log is not None else NOOP
+        peers: Dict[int, str] = {int(k): v for k, v in cfg.get("peers", {}).items()}
+        self.net = GrpcTransport(index, cfg["listen"], peers)
+        transport = self.net
+        if cfg.get("rbc", True):
+            transport = RbcTransport(self.net, index, n, self.ccfg.f)
+
+        verifier = None
+        kind = cfg.get("verifier", "device")
+        if kind == "device":
+            from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+            verifier = TPUVerifier(reg)
+        elif kind == "cpu":
+            from dag_rider_tpu.verifier.cpu import CPUVerifier
+
+            verifier = CPUVerifier(reg)
+        elif kind != "none":
+            raise ValueError(f"unknown verifier {kind!r}")
+
+        coin = None
+        if self.ccfg.coin == "threshold_bls":
+            coin = ThresholdCoin(coin_keys, index, n)
+        elif self.ccfg.coin == "fixed":
+            coin = FixedCoin(0)
+        elif self.ccfg.coin == "round_robin":
+            coin = RoundRobinCoin(n)
+
+        self.delivered = []
+        self.process = Process(
+            self.ccfg,
+            index,
+            transport,
+            coin=coin,
+            verifier=verifier,
+            signer=VertexSigner(seeds[index]),
+            on_deliver=self.delivered.append,
+            log=self.log,
+        )
+        self.net.attach_metrics(self.process.metrics)
+        self.ckpt_dir = cfg.get("checkpoint_dir")
+        self.ckpt_every = float(cfg.get("checkpoint_every_s", 30))
+        self.submit_interval = float(cfg.get("submit_interval_s", 0))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        if self.ckpt_dir and checkpoint.latest_round(self.ckpt_dir) is not None:
+            checkpoint.restore(self.process, self.ckpt_dir)
+            self.log.event("restored", round=self.process.round)
+
+    def submit(self, block: Block) -> None:
+        """Client API: enqueue a block for proposal (thread: pump's)."""
+        self.process.submit(block)
+
+    def start(self) -> None:
+        self.process.defer_steps = True
+        self.process.start()
+        self._thread = threading.Thread(target=self._pump_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.ckpt_dir:
+            checkpoint.save(self.process, self.ckpt_dir)
+        self.net.close()
+
+    def _pump_loop(self) -> None:
+        last_ckpt = last_submit = time.monotonic()
+        seq = 0
+        while not self._stop.is_set():
+            moved = self.net.pump(256)
+            self.process.step()
+            now = time.monotonic()
+            if self.submit_interval and now - last_submit >= self.submit_interval:
+                last_submit = now
+                seq += 1
+                self.process.submit(
+                    Block((f"n{self.process.index}-auto-{seq}".encode(),))
+                )
+            if (
+                self.ckpt_dir
+                and self.ckpt_every > 0
+                and now - last_ckpt >= self.ckpt_every
+            ):
+                last_ckpt = now
+                checkpoint.save(self.process, self.ckpt_dir)
+                self.log.event("checkpointed", round=self.process.round)
+            if not moved:
+                time.sleep(0.002)
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dag_rider_tpu.node")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    kg = sub.add_parser("keygen", help="generate committee key material")
+    kg.add_argument("--n", type=int, required=True)
+    kg.add_argument("--threshold", type=int, required=True)
+    kg.add_argument("--seed", default="dagrider-committee")
+    kg.add_argument("--out", required=True)
+    rn = sub.add_parser("run", help="run one node until interrupted")
+    rn.add_argument("--config", required=True)
+    rn.add_argument("--duration", type=float, default=0, help="0 = forever")
+    rn.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "keygen":
+        blob = generate_keys(args.n, args.threshold, args.seed)
+        with open(args.out, "w") as fh:
+            json.dump(blob, fh, indent=1)
+        print(f"wrote {args.out} (n={args.n}, threshold={args.threshold})")
+        return 0
+
+    with open(args.config) as fh:
+        cfg = json.load(fh)
+    log = NOOP
+    if args.verbose:
+        logging.basicConfig(level=logging.DEBUG, format="%(message)s")
+        log = EventLog(stdlib_sink(), node=cfg["index"])
+    node = Node(cfg, log=log)
+    node.start()
+    try:
+        if args.duration > 0:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.stop()
+    snap = node.process.metrics.snapshot()
+    print(json.dumps({"delivered": len(node.delivered), "metrics": snap}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

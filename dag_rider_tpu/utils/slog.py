@@ -67,6 +67,7 @@ def stdlib_sink(
     lg = logger if logger is not None else logging.getLogger("dag_rider_tpu")
 
     def sink(rec: Dict[str, object]) -> None:
-        lg.log(level, "%s", json.dumps(rec, default=repr, sort_keys=True))
+        if lg.isEnabledFor(level):  # skip the JSON encode when filtered
+            lg.log(level, "%s", json.dumps(rec, default=repr, sort_keys=True))
 
     return sink

@@ -41,11 +41,16 @@ _MIN_BUCKET = 16
 
 
 def _native_enabled() -> bool:
-    """Native challenge hashing on by default; DAGRIDER_NATIVE=0 disables
-    (the hashlib fallback is always available)."""
+    """Native challenge hashing on by default; DAGRIDER_NATIVE=0 (or
+    false/no/off) disables — the hashlib fallback is always available."""
     import os
 
-    return os.environ.get("DAGRIDER_NATIVE", "1") == "1"
+    return os.environ.get("DAGRIDER_NATIVE", "1").lower() not in (
+        "0",
+        "false",
+        "no",
+        "off",
+    )
 
 
 def _bucket(n: int) -> int:
