@@ -230,6 +230,34 @@ def _measure() -> None:
     else:
         _mark(f"skipping n=256 (only {left():.0f}s left)")
 
+    # -- phase B2: pipelined throughput at the headline n — overlap host
+    # prep of round k+1 with device execution of round k (dispatch_batch /
+    # resolve_batch), the steady-state shape of burst delivery.
+    if left() > 30 and result["n"] in built:
+        n = result["n"]
+        verifier, batches = built[n]
+        _mark(f"pipelined_n{n}: timing async dispatch chain")
+        pend = []
+        t0 = time.monotonic()
+        for b in batches[1:]:
+            pend.append(verifier.dispatch_batch(b))
+        oks = [verifier.resolve_batch(p) for p in pend]
+        dt = time.monotonic() - t0
+        total = sum(len(o) for o in oks)
+        if all(all(o) for o in oks):
+            sigs = total / dt
+            result["phases"][f"verify_n{n}_pipelined"] = {
+                "sigs_per_sec": round(sigs, 1),
+                "round_ms": round(1e3 * dt / len(oks), 2),
+            }
+            _mark(f"pipelined_n{n}: {sigs:,.0f} sigs/s")
+            if sigs > result["value"]:
+                result["value"] = round(sigs, 1)
+                result["vs_baseline"] = round(sigs / BASELINE, 3)
+            emit()
+        else:
+            _mark(f"pipelined_n{n}: verification failed, discarding phase")
+
     # -- phase C: wave-commit pipeline latency at the measured n
     if left() > 30 and result["n"]:
         n = result["n"]

@@ -245,19 +245,35 @@ class TPUVerifier(Verifier):
     last_prepare_s: float = 0.0
     last_dispatch_s: float = 0.0
 
-    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
-        if not vertices:
-            return []
+    def dispatch_batch(self, vertices: Sequence[Vertex]):
+        """Asynchronous half of verify: host prep + device dispatch, NO
+        sync. Returns an opaque (device_mask, count) pending handle for
+        :meth:`resolve_batch`. Lets a caller overlap round k+1's host prep
+        with round k's device execution — the steady-state pipeline shape
+        of burst delivery (one dispatch per DAG round)."""
         size = _bucket(len(vertices))
-        # Trace annotations are free when no profiler is attached; under
-        # jax.profiler.trace() (bench.py DAGRIDER_PROFILE_DIR / SURVEY §5)
-        # they label the host-prep vs device-dispatch split per round.
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.prepare"):
             args = self._prepare(vertices, size)
         self.last_prepare_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.dispatch"):
-            mask = np.asarray(_device_verify(*(jnp.asarray(a) for a in args)))
+            mask = _device_verify(*(jnp.asarray(a) for a in args))
+        return mask, len(vertices)
+
+    @staticmethod
+    def resolve_batch(pending) -> List[bool]:
+        """Blocking half: device mask -> per-vertex host bools."""
+        mask, count = pending
+        return [bool(m) for m in np.asarray(mask)[:count]]
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        if not vertices:
+            return []
+        # Trace annotations are free when no profiler is attached; under
+        # jax.profiler.trace() (bench.py DAGRIDER_PROFILE_DIR / SURVEY §5)
+        # they label the host-prep vs device-dispatch split per round.
+        pending = self.dispatch_batch(vertices)
+        t0 = time.perf_counter()
+        out = self.resolve_batch(pending)
         self.last_dispatch_s = time.perf_counter() - t0
-        return [bool(m) for m in mask[: len(vertices)]]
+        return out
