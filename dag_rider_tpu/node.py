@@ -42,7 +42,6 @@ from dag_rider_tpu.consensus.coin import FixedCoin, RoundRobinCoin, ThresholdCoi
 from dag_rider_tpu.consensus.process import Process
 from dag_rider_tpu.core.types import Block
 from dag_rider_tpu.crypto import threshold as th
-from dag_rider_tpu.transport.net import GrpcTransport
 from dag_rider_tpu.transport.rbc import RbcTransport
 from dag_rider_tpu.utils import checkpoint
 from dag_rider_tpu.utils.slog import EventLog, NOOP, stdlib_sink
@@ -109,6 +108,10 @@ class Node:
 
         self.log = log if log is not None else NOOP
         peers: Dict[int, str] = {int(k): v for k, v in cfg.get("peers", {}).items()}
+        # Lazy: transport/net.py imports grpc at module scope, and grpcio
+        # is the optional [net] extra — keygen must work without it.
+        from dag_rider_tpu.transport.net import GrpcTransport
+
         self.net = GrpcTransport(index, cfg["listen"], peers)
         transport = self.net
         if cfg.get("rbc", True):
