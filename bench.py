@@ -92,6 +92,10 @@ def _probe() -> None:
 # Stage: measure (phased, deadline-aware, cumulative JSON after each phase)
 # ----------------------------------------------------------------------
 
+def _quorum(n: int) -> int:
+    return 2 * ((n - 1) // 3) + 1
+
+
 def _signed_round(signers, n: int, rnd: int, quorum: int):
     """One round's signed vertex batch (the unit every bench phase uses).
 
@@ -123,7 +127,7 @@ def _build_batches(n: int, rounds: int):
 
     reg, seeds = KeyRegistry.generate(n)
     signers = [VertexSigner(s) for s in seeds]
-    quorum = 2 * ((n - 1) // 3) + 1
+    quorum = _quorum(n)
     batches = [
         _signed_round(signers, n, r + 1, quorum) for r in range(rounds)
     ]
@@ -272,7 +276,7 @@ def _measure() -> None:
         _mark("wave pipeline: warm + timing")
         from dag_rider_tpu.ops import dag_kernels
 
-        quorum = 2 * ((n - 1) // 3) + 1
+        quorum = _quorum(n)
         rng = np.random.default_rng(7)
         strong_wave = jnp.asarray(
             rng.random((3, n, n)) < min(1.0, (quorum + 0.5) / n)
@@ -318,7 +322,7 @@ def _measure() -> None:
         signers = [VertexSigner(s) for s in seeds]
         # Pre-warm every bucket size partial bursts can produce (16/32/64)
         # so no compile lands inside the timed box.
-        quorum = 2 * ((n - 1) // 3) + 1
+        quorum = _quorum(n)
         warm_all = _signed_round(signers, n, 1, quorum)
         for sz in (9, 17, 63):  # buckets 16, 32, 64
             shared.verify_batch(warm_all[:sz])

@@ -45,6 +45,11 @@ class Config:
            1-D "batch" mesh over vertices, (4, 2) for (batch, shard).
         mesh_axis_names: names for the mesh axes.
         max_rounds: capacity hint for dense DAG tensors (grown on demand).
+        sync_patience: quiescent step() passes with a stuck buffer before
+           a process broadcasts a catch-up sync request (0 disables the
+           anti-entropy protocol — elastic recovery, SURVEY §5).
+        sync_window: max rounds served per sync request (bounds responder
+           amplification together with the per-requester serve cap).
     """
 
     n: int = 4
@@ -57,6 +62,17 @@ class Config:
     mesh_shape: Tuple[int, ...] = (1,)
     mesh_axis_names: Tuple[str, ...] = ("batch",)
     max_rounds: int = 64
+    sync_patience: int = 8
+    sync_window: int = 8
+    # Wall-clock flood control (0 disables, e.g. in lockstep simulations):
+    # a requester spaces its sync requests by at least
+    # sync_request_cooldown_s, and a responder serves any one requester at
+    # most once per sync_serve_cooldown_s. Rate limits rather than
+    # lifetime caps: a lost response can always be re-requested later
+    # (no permanent wedge), and a Byzantine requester rotating windows
+    # still extracts at most one window per cooldown.
+    sync_request_cooldown_s: float = 0.5
+    sync_serve_cooldown_s: float = 0.2
 
     def __post_init__(self) -> None:
         if self.n < 1:

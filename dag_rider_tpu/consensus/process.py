@@ -34,6 +34,7 @@ process/network boundary sits in the reference (``process.go:186``).
 
 from __future__ import annotations
 
+import time as _time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Set
 
@@ -99,6 +100,9 @@ class Process:
         self._pending_waves: Set[int] = set()
         self.delivered: Set[VertexID] = set()
         self.delivered_log: List[VertexID] = []
+        self._stuck_steps = 0
+        self._sync_last_request = float("-inf")
+        self._sync_last_serve: Dict[int, float] = {}  # requester -> mono
         self._seen_digests: Dict[VertexID, bytes] = {}
         self.metrics = Metrics()
         self._started = False
@@ -152,6 +156,9 @@ class Process:
         influence any state.
         """
         self.metrics.inc("msgs_received")
+        if msg.kind == "sync":
+            self._serve_sync(msg)
+            return
         if msg.kind != "val" or msg.vertex is None:
             # RBC control traffic (echo/ready/fetch) is consumed by the
             # transport/rbc.py stage; a Process only eats vertex payloads.
@@ -259,6 +266,7 @@ class Process:
         buffer-drain, round advancement, wave commits and proposals repeat
         until no further progress is possible.
         """
+        made_progress = False
         progress = True
         while progress:
             progress = False
@@ -266,6 +274,8 @@ class Process:
             progress |= self._drain_buffer()
             progress |= self._try_advance()
             progress |= self._retry_pending_waves()
+            made_progress |= progress
+        self._maybe_request_sync(made_progress)
 
     def _drain_buffer(self) -> bool:
         """Admit buffered vertices whose predecessors are all present
@@ -405,6 +415,94 @@ class Process:
                 for (r2, j) in dag.weak.get((r, i), ()):
                     reached[r2, j] = True
         return tuple(weak)
+
+    # ------------------------------------------------------------------
+    # Catch-up sync (anti-entropy) — elastic recovery, SURVEY §5.
+    #
+    # A process that was down (or partitioned) while the cluster advanced
+    # has buffered vertices whose predecessors nobody will re-broadcast:
+    # without this, it stalls forever (the reference has the same hole,
+    # plus no persistence at all). Requesters ask for a bounded round
+    # window once the buffer has been stuck for `sync_patience` steps;
+    # responders re-broadcast their *original signed* vertices for those
+    # rounds, capped per (requester, window). Served vertices flow through
+    # the normal admission path — signatures, stamps and (with RBC) the
+    # Bracha consistency machinery still gate them, so a Byzantine
+    # "helper" cannot use sync to smuggle an equivocation.
+    # ------------------------------------------------------------------
+
+    def _maybe_request_sync(self, made_progress: bool = False) -> None:
+        if self.cfg.sync_patience <= 0 or not self.buffer or made_progress:
+            # any forward progress resets patience — a node that is being
+            # fed (however slowly) is not partitioned
+            self._stuck_steps = 0
+            return
+        self._stuck_steps += 1
+        if self._stuck_steps < self.cfg.sync_patience:
+            return
+        now = _time.monotonic()
+        if now - self._sync_last_request < self.cfg.sync_request_cooldown_s:
+            return  # patience keeps accruing; request fires on cooldown
+        self._stuck_steps = 0
+        self._sync_last_request = now
+        lo: Optional[int] = None
+        for v in self.buffer:
+            for e in (*v.strong_edges, *v.weak_edges):
+                if e.round >= 1 and not self.dag.present(e):
+                    lo = e.round if lo is None else min(lo, e.round)
+        if lo is None:
+            return
+        # Anchor at our own frontier: buffered vertices only reveal the
+        # round directly below themselves, so chasing their predecessors
+        # would walk the gap backward one round per request. Everything
+        # <= self.round is already quorum-complete locally; the window
+        # that actually unblocks us starts right above it.
+        lo = min(lo, self.round + 1)
+        hi = lo + self.cfg.sync_window - 1
+        self.metrics.inc("sync_requested")
+        self.log.event("sync_request", lo=lo, hi=hi)
+        self.transport.broadcast(
+            BroadcastMessage(
+                vertex=None,
+                round=lo,
+                sender=self.index,
+                kind="sync",
+                origin=hi,
+            )
+        )
+
+    def _serve_sync(self, msg: BroadcastMessage) -> None:
+        # Requester id is range-checked (spoofable in-protocol, but the
+        # throttle table stays bounded at n entries) and self-requests are
+        # ignored.
+        if not 0 <= msg.sender < self.cfg.n or msg.sender == self.index:
+            return
+        lo = max(1, msg.round)
+        hi = msg.origin if msg.origin is not None else lo
+        hi = min(hi, lo + self.cfg.sync_window - 1, self.round)
+        if hi < lo:
+            return
+        # Rate limit per requester (not per window — window rotation must
+        # not multiply the budget, and a lost response must be
+        # re-requestable once the cooldown passes).
+        now = _time.monotonic()
+        if (
+            now - self._sync_last_serve.get(msg.sender, float("-inf"))
+            < self.cfg.sync_serve_cooldown_s
+        ):
+            self.metrics.inc("sync_throttled")
+            return
+        self._sync_last_serve[msg.sender] = now
+        count = 0
+        for r in range(lo, hi + 1):
+            for v in self.dag.vertices_in_round(r):
+                self.transport.broadcast(
+                    BroadcastMessage(vertex=v, round=v.round, sender=v.source)
+                )
+                count += 1
+        if count:
+            self.metrics.inc("sync_served", count)
+            self.log.event("sync_serve", lo=lo, hi=hi, vertices=count)
 
     # ------------------------------------------------------------------
     # Wave commit (Algorithm 3, quoted at process.go:315-325, 358-361)

@@ -61,6 +61,11 @@ class RbcTransport(Transport):
         # digest that reached READY quorum per slot (unique by consistency)
         self._decided: Dict[Slot, bytes] = {}
         self._serves: Dict[Slot, int] = {}
+        # READY-refresh flood control: rate limit per slot rather than a
+        # lifetime budget — an exhaustible budget could be drained by
+        # replayed VALs, permanently breaking catch-up for that slot.
+        self.ready_refresh_cooldown_s = 0.2
+        self._ready_refresh_at: Dict[Slot, float] = {}
         self._echoes: Dict[Tuple[Slot, bytes], Set[int]] = {}
         self._readies: Dict[Tuple[Slot, bytes], Set[int]] = {}
 
@@ -79,9 +84,11 @@ class RbcTransport(Transport):
     def broadcast(self, msg: BroadcastMessage) -> None:
         """r_bcast: send VAL and join the echo voting for our own vertex
         (the inner broker excludes the sender from fan-out, so the sender's
-        ECHO/READY participation happens locally here)."""
+        ECHO/READY participation happens locally here). Consensus-level
+        control messages (sync) ride the wire without Bracha processing."""
         self.inner.broadcast(msg)
-        self._on_val(msg)
+        if msg.kind == "val" and msg.vertex is not None:
+            self._on_val(msg)
 
     # -- protocol -----------------------------------------------------------
 
@@ -94,6 +101,10 @@ class RbcTransport(Transport):
             self._on_ready(msg)
         elif msg.kind == "fetch":
             self._on_fetch(msg)
+        elif self._handler is not None:
+            # consensus-level control (sync requests) passes straight up;
+            # the Process validates and handles it.
+            self._handler(msg)
 
     def _ctrl(self, kind: str, slot: Slot, digest: bytes) -> None:
         self.inner.broadcast(
@@ -135,6 +146,24 @@ class RbcTransport(Transport):
             self._vote(self._echoes, slot, digest, self.index)
             self._ctrl("echo", slot, digest)
             self._maybe_ready(slot, digest)
+        elif self._decided.get(slot) == digest:
+            # Catch-up support: a repeat VAL for a slot we already decided
+            # is a laggard being served (Process._serve_sync re-broadcasts
+            # old vertices). Our Bracha instance is long done and would
+            # never re-send READY, so the laggard could hold the payload
+            # yet never re-reach a READY quorum. Re-sending our READY
+            # (rate-limited per slot) lets 2f+1 up-to-date peers rebuild
+            # that quorum — consistency is untouched because only the
+            # decided digest is ever refreshed.
+            import time as _time
+
+            now = _time.monotonic()
+            if (
+                now - self._ready_refresh_at.get(slot, float("-inf"))
+                >= self.ready_refresh_cooldown_s
+            ):
+                self._ready_refresh_at[slot] = now
+                self._ctrl("ready", slot, digest)
         self._maybe_deliver(slot)
 
     def _on_echo(self, msg: BroadcastMessage) -> None:
