@@ -22,6 +22,8 @@ Config (JSON):
   "rbc": true,                     // Bracha reliable broadcast stage
   "verifier": "device",            // "device" | "cpu" | "none"
   "coin": "threshold_bls",         // | "round_robin" | "fixed"
+  "coin_msm": "host",              // "device": share aggregation on the mesh
+
   "checkpoint_dir": "ckpt/node0",  // optional, periodic + on shutdown
   "checkpoint_every_s": 30,
   "submit_interval_s": 0.5         // synthetic client load (0: none)
@@ -132,7 +134,15 @@ class Node:
 
         coin = None
         if self.ccfg.coin == "threshold_bls":
-            coin = ThresholdCoin(coin_keys, index, n)
+            msm = None
+            msm_kind = cfg.get("coin_msm", "host")
+            if msm_kind == "device":
+                from dag_rider_tpu.parallel.msm import ShardedMSM
+
+                msm = ShardedMSM()
+            elif msm_kind != "host":
+                raise ValueError(f"unknown coin_msm {msm_kind!r}")
+            coin = ThresholdCoin(coin_keys, index, n, msm=msm)
         elif self.ccfg.coin == "fixed":
             coin = FixedCoin(0)
         elif self.ccfg.coin == "round_robin":

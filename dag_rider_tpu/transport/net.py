@@ -93,6 +93,12 @@ class GrpcTransport(Transport):
         # one is passed / attached, so net_* counters appear in the same
         # snapshot as the consensus counters.
         self.metrics = metrics if metrics is not None else Metrics()
+        # Failure detection (SURVEY §5): consecutive send failures per
+        # peer; a peer is reported down after `down_after` in a row and
+        # up again on the first success. Detection only — consensus
+        # tolerates the faults; operators get the signal.
+        self.down_after = 3
+        self._consec_fail: Dict[int, int] = {}
         from concurrent import futures
 
         self._server = grpc.server(
@@ -193,18 +199,33 @@ class GrpcTransport(Transport):
         except Exception:  # cancelled: treat as failure
             exc = fut
         if exc is None:
-            self._inc("net_sends_ok")
+            with self._lock:
+                self.metrics.inc("net_sends_ok")
+                was_down = self._consec_fail.get(peer, 0) >= self.down_after
+                self._consec_fail[peer] = 0
+            if was_down:
+                self._inc("net_peer_recovered")
             return
         self._on_failure(peer, payload, attempt)
 
     def _on_failure(self, peer: int, payload: bytes, attempt: int) -> None:
         if self._closed:
             return
-        self._inc("net_send_errors")
         if attempt >= self._retries:
-            self._inc("net_drops")
+            # The failure detector counts *logical messages* whose whole
+            # retry chain was exhausted — a single message's transient
+            # retry burst must not trip the down threshold by itself.
+            with self._lock:
+                self.metrics.inc("net_send_errors")
+                self.metrics.inc("net_drops")
+                self._consec_fail[peer] = self._consec_fail.get(peer, 0) + 1
+                just_down = self._consec_fail[peer] == self.down_after
+            if just_down:
+                self._inc("net_peer_down")
             return
-        self._inc("net_retries")
+        with self._lock:
+            self.metrics.inc("net_send_errors")
+            self.metrics.inc("net_retries")
         delay = self._retry_backoff_s * (2**attempt)
         timer = threading.Timer(
             delay, lambda: (self._timers.discard(timer),
@@ -237,6 +258,20 @@ class GrpcTransport(Transport):
     def pending(self) -> int:
         with self._lock:
             return len(self._inbox)
+
+    def peer_status(self) -> Dict[int, str]:
+        """Failure-detector view: peer -> "up" | "down" (down = at least
+        ``down_after`` consecutive send failures with no success since)."""
+        with self._lock:
+            return {
+                peer: (
+                    "down"
+                    if self._consec_fail.get(peer, 0) >= self.down_after
+                    else "up"
+                )
+                for peer in self._peers
+                if peer != self.index
+            }
 
     def close(self) -> None:
         self._closed = True

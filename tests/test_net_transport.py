@@ -250,3 +250,55 @@ def test_grpc_16_node_cluster_with_rbc_reaches_consensus():
     finally:
         for t in nets:
             t.close()
+
+
+def test_failure_detector_marks_peer_down_and_recovers():
+    """SURVEY §5 failure detection: consecutive send failures mark a peer
+    down; the first success marks it up again."""
+    import time
+
+    victim = GrpcTransport(1, "127.0.0.1:0", {})
+    victim_addr = f"127.0.0.1:{victim.bound_port}"
+    victim.subscribe(1, lambda m: None)
+    victim.close()  # peer starts dead
+
+    t0 = GrpcTransport(
+        0,
+        "127.0.0.1:0",
+        {1: victim_addr},
+        retries=0,
+        rpc_timeout_s=0.3,
+    )
+    try:
+        v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+        msg = BroadcastMessage(vertex=v, round=1, sender=0)
+        deadline = time.time() + 15
+        while (
+            time.time() < deadline
+            and t0.peer_status().get(1) != "down"
+        ):
+            t0.broadcast(msg)
+            time.sleep(0.05)
+        assert t0.peer_status() == {1: "down"}
+        assert t0.metrics.counters["net_peer_down"] == 1
+
+        # peer comes back on the same address
+        revived = GrpcTransport(1, victim_addr, {})
+        if revived.bound_port == 0:  # port was re-grabbed meanwhile
+            revived.close()
+            pytest.skip("ephemeral port reused by another process")
+        try:
+            revived.subscribe(1, lambda m: None)
+            deadline = time.time() + 15
+            while (
+                time.time() < deadline
+                and t0.peer_status().get(1) != "up"
+            ):
+                t0.broadcast(msg)
+                time.sleep(0.05)
+            assert t0.peer_status() == {1: "up"}
+            assert t0.metrics.counters["net_peer_recovered"] >= 1
+        finally:
+            revived.close()
+    finally:
+        t0.close()
