@@ -1,0 +1,78 @@
+"""Determinism asserts (SURVEY §5: race detection / JAX-side determinism).
+
+Two independent constructions with identical seeds must produce
+byte-identical outcomes: the randomized-schedule simulation (delivered
+logs) and the device verify dispatch (accept masks, run twice on the
+same backend).
+"""
+
+import dataclasses
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.simulator import RandomizedScheduler, Simulation
+from dag_rider_tpu.core.types import Block, Vertex, VertexID
+from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+
+def _run_once(seed: int):
+    cfg = Config(n=4, coin="round_robin", propose_empty=False)
+    broker = InMemoryTransport()
+    sim = Simulation(cfg, transport=broker)
+    sim.submit_blocks(per_process=8)
+    for p in sim.processes:
+        p.defer_steps = True
+        p.start()
+    sched = RandomizedScheduler(broker, seed=seed)
+    for _ in range(5000):
+        if not sched.run(max_messages=1):
+            for p in sim.processes:
+                p.step()
+            if broker.pending == 0:
+                break
+    for p in sim.processes:
+        p.step()
+    return sim
+
+
+def _logs(sim):
+    return [
+        [(v.id.round, v.id.source, v.digest()) for v in sim.deliveries[i]]
+        for i in range(sim.cfg.n)
+    ]
+
+
+def test_same_seed_same_delivery():
+    a = _run_once(1234)
+    b = _run_once(1234)
+    assert _logs(a) == _logs(b)
+    assert any(log for log in _logs(a)), "nothing was delivered"
+
+
+def test_different_seed_may_reorder_but_agrees_internally():
+    # different interleavings still satisfy per-run agreement (the
+    # canonical all-pairs prefix check, not a re-implementation)
+    for seed in (1, 2):
+        sim = _run_once(seed)
+        sim.check_agreement()
+        assert max(len(l) for l in _logs(sim)) > 0
+
+
+def test_device_verify_is_deterministic():
+    reg, seeds = KeyRegistry.generate(8)
+    signers = [VertexSigner(s) for s in seeds]
+    vs = []
+    for i in range(8):
+        v = Vertex(
+            id=VertexID(1, i),
+            block=Block((f"tx{i}".encode(),)),
+            strong_edges=tuple(VertexID(0, s) for s in range(5)),
+        )
+        vs.append(signers[i].sign_vertex(v))
+    vs[3] = dataclasses.replace(vs[3], signature=bytes(64))
+    ver = TPUVerifier(reg)
+    first = ver.verify_batch(vs)
+    for _ in range(3):
+        assert ver.verify_batch(vs) == first
+    assert first == [True, True, True, False, True, True, True, True]
