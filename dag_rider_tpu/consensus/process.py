@@ -432,7 +432,18 @@ class Process:
     # ------------------------------------------------------------------
 
     def _maybe_request_sync(self, made_progress: bool = False) -> None:
-        if self.cfg.sync_patience <= 0 or not self.buffer or made_progress:
+        # Stuck = no progress while there is something to wait for: a
+        # non-empty buffer (missing predecessors), or queued client blocks
+        # with an incomplete current round (our — or our peers' — round-r
+        # broadcasts were lost, so everyone's buffers can be EMPTY while
+        # the cluster deadlocks; a quiescent cluster with no pending
+        # blocks is *idle*, not stuck, and must not request forever).
+        waiting = bool(self.buffer) or (
+            bool(self.blocks_to_propose)
+            and self.round >= 1
+            and self.dag.round_size(self.round) < self.cfg.quorum
+        )
+        if self.cfg.sync_patience <= 0 or made_progress or not waiting:
             # any forward progress resets patience — a node that is being
             # fed (however slowly) is not partitioned
             self._stuck_steps = 0
@@ -450,14 +461,27 @@ class Process:
             for e in (*v.strong_edges, *v.weak_edges):
                 if e.round >= 1 and not self.dag.present(e):
                     lo = e.round if lo is None else min(lo, e.round)
-        if lo is None:
+        if lo is not None:
+            # Anchor at our own frontier: buffered vertices only reveal
+            # the round directly below themselves, so chasing their
+            # predecessors would walk the gap backward one round per
+            # request. Rounds < self.round are quorum-complete locally,
+            # but self.round itself may not be (lost broadcasts).
+            lo = min(lo, max(1, self.round))
+        elif (
+            self.blocks_to_propose
+            and self.round >= 1
+            and self.dag.round_size(self.round) < self.cfg.quorum
+        ):
+            # Nothing is missing *below* the buffer, but we want to
+            # advance and our current round lacks quorum (lost
+            # broadcasts): ask for the current round.
+            lo = self.round
+        else:
+            # Nothing sync can provide (e.g. idle with future-round
+            # vertices buffered and no client blocks): requesting would
+            # be a perpetual O(n^2) duplicate-traffic loop.
             return
-        # Anchor at our own frontier: buffered vertices only reveal the
-        # round directly below themselves, so chasing their predecessors
-        # would walk the gap backward one round per request. Everything
-        # <= self.round is already quorum-complete locally; the window
-        # that actually unblocks us starts right above it.
-        lo = min(lo, self.round + 1)
         hi = lo + self.cfg.sync_window - 1
         self.metrics.inc("sync_requested")
         self.log.event("sync_request", lo=lo, hi=hi)

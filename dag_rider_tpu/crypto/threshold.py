@@ -165,11 +165,13 @@ def batch_verify_shares(
     1. one random-linear-combination check over the whole set
        (2 Miller loops + two small-scalar MSMs): all-honest sets pass
        with exactly one pairing-product evaluation;
-    2. on failure, single-bad-share localization from two GT defect
-       values: with errors e_i = sigma_i - [sk_i]H, unit coefficients
-       give V1 = e(-sum e_i, g2) and x-weighted coefficients give
-       V2 = e(-sum x_i e_i, g2); one bad index j makes V2 == V1^(x_j),
-       found by an incremental GT power scan (Fp12 muls, microseconds);
+    2. on failure, single-bad-share localization from the failed check's
+       own GT defect plus one x-weighted defect: with errors
+       e_i = sigma_i - [sk_i]H, the RLC product gives
+       v_c = e(-sum c_i e_i, g2) and x-scaled coefficients give
+       v2 = e(-sum c_i x_i e_i, g2); one bad index j makes
+       v2 == v_c^(x_j), found by an incremental GT power scan (Fp12
+       muls, microseconds) — only one extra pairing product total;
     3. bisection over RLC checks for the multi-bad case, O(bad * log n)
        pairing products.
 
@@ -187,42 +189,47 @@ def batch_verify_shares(
     if not decoded:
         return {}
 
-    def rlc_holds(subset: List[Tuple[int, tuple]]) -> bool:
+    def rlc_product(
+        subset: List[Tuple[int, tuple]], weights: Optional[List[int]] = None
+    ) -> tuple:
+        """GT defect of the subset under (optionally x-scaled) Fiat-Shamir
+        coefficients: FP12_ONE iff every share in the subset verifies."""
         cs = _rlc_coeffs(wave, [(s, shares[s]) for s, _ in subset])
+        if weights is not None:
+            cs = [c * w for c, w in zip(cs, weights)]
         pts = [pt for _, pt in subset]
         sig_comb = msm(cs, pts) if msm is not None else bls.g1_msm(cs, pts)
         pk_comb = bls.g2_msm(cs, [share_pks[s] for s, _ in subset])
-        return bls.pairing_check([(sig_comb, neg_g2), (h_pt, pk_comb)])
+        return bls.pairing_product([(sig_comb, neg_g2), (h_pt, pk_comb)])
 
-    if rlc_holds(decoded):
+    def rlc_holds(subset: List[Tuple[int, tuple]]) -> bool:
+        return rlc_product(subset) == bls.FP12_ONE
+
+    v_c = rlc_product(decoded)
+    if v_c == bls.FP12_ONE:
         return {s: shares[s] for s, _ in decoded}
 
-    # One-bad-share localization via GT defect ratio.
-    ones = [1] * len(decoded)
+    # One-bad-share localization from the defect we already have: with
+    # errors e_i = sigma_i - [sk_i]H, v_c = e(-sum c_i e_i, g2); weighting
+    # the same coefficients by x_i = src_i + 1 gives
+    # v2 = e(-sum c_i x_i e_i, g2). A single bad index j makes
+    # v2 == v_c^(x_j) — found by an incremental GT power scan.
     xs = [s + 1 for s, _ in decoded]
-    pts = [pt for _, pt in decoded]
-    pks = [share_pks[s] for s, _ in decoded]
-    v1 = bls.pairing_product(
-        [(bls.g1_msm(ones, pts), neg_g2), (h_pt, bls.g2_msm(ones, pks))]
-    )
-    if v1 != bls.FP12_ONE:
-        v2 = bls.pairing_product(
-            [(bls.g1_msm(xs, pts), neg_g2), (h_pt, bls.g2_msm(xs, pks))]
-        )
-        by_x = {x: s for x, (s, _) in zip(xs, decoded)}
-        power = v1  # v1^x at loop head
-        bad_src = None
-        for x in range(1, max(xs) + 1):
-            if x in by_x and power == v2:
-                bad_src = by_x[x]
-                break
-            power = bls.fp12_mul(power, v1)
-        if bad_src is not None:
-            rest = [(s, pt) for s, pt in decoded if s != bad_src]
-            if not rest:
-                return {}
-            if rlc_holds(rest):
-                return {s: shares[s] for s, _ in rest}
+    v2 = rlc_product(decoded, weights=xs)
+    by_x = {x: s for x, (s, _) in zip(xs, decoded)}
+    power = v_c  # v_c^x at loop head
+    bad_src = None
+    for x in range(1, max(xs) + 1):
+        if x in by_x and power == v2:
+            bad_src = by_x[x]
+            break
+        power = bls.fp12_mul(power, v_c)
+    if bad_src is not None:
+        rest = [(s, pt) for s, pt in decoded if s != bad_src]
+        if not rest:
+            return {}
+        if rlc_holds(rest):
+            return {s: shares[s] for s, _ in rest}
 
     # Multiple bad shares: bisect. Precondition of _failed: the subset's
     # RLC check is already known False (the full set failed above), so

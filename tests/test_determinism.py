@@ -76,3 +76,34 @@ def test_device_verify_is_deterministic():
     for _ in range(3):
         assert ver.verify_batch(vs) == first
     assert first == [True, True, True, False, True, True, True, True]
+
+
+def test_cpu_vs_device_verifier_commit_order_byte_identical():
+    """The north-star determinism claim (BASELINE.json): the same cluster
+    run with the CPU oracle verifier and with the device verifier must
+    a_deliver byte-identical total orders — all ordering decisions stay
+    host-side; the verifier contributes only accept bits."""
+    from dag_rider_tpu.verifier.cpu import CPUVerifier
+
+    def run(verifier_cls):
+        cfg = Config(n=4, coin="round_robin", propose_empty=False)
+        reg, key_seeds = KeyRegistry.generate(4)
+        signers = [VertexSigner(s) for s in key_seeds]
+        shared = verifier_cls(reg)
+        sim = Simulation(
+            cfg,
+            verifier_factory=lambda i: shared,
+            signer_factory=lambda i: signers[i],
+        )
+        sim.submit_blocks(per_process=10)
+        sim.run(max_messages=50_000)
+        sim.check_agreement()
+        return [
+            [(v.id.round, v.id.source, v.digest()) for v in sim.deliveries[i]]
+            for i in range(4)
+        ]
+
+    cpu_logs = run(CPUVerifier)
+    dev_logs = run(TPUVerifier)
+    assert any(cpu_logs), "nothing delivered"
+    assert cpu_logs == dev_logs
