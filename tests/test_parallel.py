@@ -169,3 +169,35 @@ def test_sharded_msm_matches_host_oracle(mesh):
     sigma_host = th.aggregate(shares, 3)
     assert sigma_dev == sigma_host
     assert th.verify_group(keys.group_pk, 2, sigma_dev)
+
+
+def test_sharded_verifier_large_batch_matches_cpu_oracle(mesh):
+    """Scale check at a 128-vertex batch (16/device on the 8-device mesh):
+    the sharded device masks must equal the CPU oracle's bit for bit,
+    including corrupted rows — large-bucket padding/slicing bugs must
+    surface here, not in the one-shot TPU bench."""
+    import dataclasses
+
+    from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+    from dag_rider_tpu.verifier.cpu import CPUVerifier
+
+    n = 128
+    reg, seeds = KeyRegistry.generate(n)
+    signers = [VertexSigner(s) for s in seeds]
+    quorum = 2 * ((n - 1) // 3) + 1
+    vs = []
+    for i in range(n):
+        v = Vertex(
+            id=VertexID(1, i),
+            block=Block((f"tx-{i}".encode(),)),
+            strong_edges=tuple(VertexID(0, s) for s in range(quorum)),
+        )
+        vs.append(signers[i].sign_vertex(v))
+    # corruptions sprinkled across shard boundaries
+    vs[0] = dataclasses.replace(vs[0], signature=bytes(64))
+    vs[17] = dataclasses.replace(vs[17], signature=vs[18].signature)
+    vs[127] = dataclasses.replace(vs[127], block=Block((b"tampered",)))
+    want = CPUVerifier(reg).verify_batch(vs)
+    got = ShardedTPUVerifier(reg, mesh).verify_batch(vs)
+    assert got == want
+    assert want.count(False) == 3 and not want[0] and not want[17] and not want[127]
