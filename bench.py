@@ -157,15 +157,19 @@ def _measure() -> None:
 
     t0 = time.monotonic()
     backend = jax.default_backend()
+    device_kind = getattr(jax.devices()[0], "device_kind", "?")
     init_s = time.monotonic() - t0
-    _mark(f"measure: backend '{backend}' up in {init_s:.1f}s")
+    _mark(f"measure: backend '{backend}' ({device_kind}) up in {init_s:.1f}s")
 
     result = {
         "metric": "vertex_sigs_per_sec",
         "value": 0.0,
         "unit": "sigs/s",
         "vs_baseline": 0.0,
+        # the axon PJRT plugin registers the chip under platform "axon";
+        # device_kind carries the actual hardware (e.g. TPU v5e)
         "backend": backend,
+        "device_kind": device_kind,
         "n": 0,
         "phases": {"backend_init_s": round(init_s, 1)},
         "ladder": {},
