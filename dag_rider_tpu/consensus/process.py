@@ -235,16 +235,25 @@ class Process:
             wave = v.round // self.cfg.wave_length
             self.coin.observe_share(wave, v.source, v.coin_share)
 
-    def _drain_verify(self) -> None:
-        """Batch-verify queued vertices through the Verifier seam — one
-        whole batch per dispatch (the north-star shape)."""
-        if not self._pending_verify:
-            return
+    def take_verify_batch(self) -> List[Vertex]:
+        """Pop the pending-verify queue without verifying — the collect
+        half of cross-process dispatch coalescing: a driver that owns
+        several processes sharing one device Verifier gathers every
+        process's batch and issues ONE merged dispatch
+        (Verifier.verify_rounds), then hands each mask back through
+        :meth:`apply_verify_mask`. Per-vertex accept bits are a pure
+        function of (vertex bytes, registry), so coalescing cannot change
+        any process's behavior."""
         batch, self._pending_verify = self._pending_verify, []
         self._pending_verify_ids.clear()
-        with Timer() as t:
-            ok = self.verifier.verify_batch(batch)
-        self.metrics.observe_verify_batch(len(batch), t.seconds)
+        return batch
+
+    def apply_verify_mask(
+        self, batch: List[Vertex], ok: List[bool], seconds: float
+    ) -> None:
+        """Admit/reject a previously collected batch (apply half of the
+        coalescing protocol; also the tail of :meth:`_drain_verify`)."""
+        self.metrics.observe_verify_batch(len(batch), seconds)
         for v, good in zip(batch, ok):
             if good:
                 self._admit_to_buffer(v)
@@ -253,6 +262,16 @@ class Process:
                 self.log.event(
                     "reject_signature", round=v.round, source=v.source
                 )
+
+    def _drain_verify(self) -> None:
+        """Batch-verify queued vertices through the Verifier seam — one
+        whole batch per dispatch (the north-star shape)."""
+        if not self._pending_verify:
+            return
+        batch = self.take_verify_batch()
+        with Timer() as t:
+            ok = self.verifier.verify_batch(batch)
+        self.apply_verify_mask(batch, ok, t.seconds)
 
     # ------------------------------------------------------------------
     # The progress engine (Algorithm 2 lines 5-15)

@@ -18,6 +18,7 @@ from dag_rider_tpu.consensus.process import Process
 from dag_rider_tpu.core.types import Block, Vertex
 from dag_rider_tpu.transport.base import Transport
 from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.utils.metrics import Timer
 from dag_rider_tpu.utils.slog import NOOP
 
 
@@ -83,6 +84,16 @@ class Simulation:
         pump = getattr(self.transport, "pump", None)
         if pump is None:
             raise TypeError("transport has no pump; drive it externally")
+        # Cross-process dispatch coalescing: when every process shares ONE
+        # Verifier instance (the bench's device configuration), all n
+        # processes' burst batches merge into a single padded device
+        # dispatch per pump cycle (Verifier.verify_rounds) — n-1 fewer
+        # fixed per-dispatch costs per cycle, identical accept bits.
+        shared = self.processes[0].verifier if self.processes else None
+        coalesce = (
+            len(self.processes) > 1
+            and all(p.verifier is shared for p in self.processes)
+        )
         for p in self.processes:
             p.defer_steps = True
         try:
@@ -91,6 +102,21 @@ class Simulation:
             delivered = 0
             while True:
                 got = pump(max_messages - delivered)
+                if coalesce:
+                    batches = [p.take_verify_batch() for p in self.processes]
+                    if any(batches):
+                        with Timer() as t:
+                            masks = shared.verify_rounds(batches)
+                        # Attribute the merged dispatch time size-
+                        # proportionally and skip empty batches — charging
+                        # every process the full wall time would corrupt
+                        # per-process sigs_per_sec / p50 metrics.
+                        total = sum(len(b) for b in batches)
+                        for p, b, m in zip(self.processes, batches, masks):
+                            if b:
+                                p.apply_verify_mask(
+                                    b, m, t.seconds * len(b) / total
+                                )
                 for p in self.processes:
                     p.step()
                 if got == 0 or delivered + got >= max_messages:

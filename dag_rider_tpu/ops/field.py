@@ -143,17 +143,20 @@ _NCOLS = 46  # 43 product columns + headroom so no carry is ever dropped
 def _columns(a: jax.Array, b: jax.Array) -> jax.Array:
     """Schoolbook product columns c[k] = sum_{i+j=k} a_i b_j -> [..., 46].
 
-    Pad/reshape anti-diagonal trick: pad rows of the outer product to width
-    47 and flatten; element (i, j) lands at flat offset 47*i + j, which in a
-    width-46 view is row i, column i + j. Static shapes; no gathers.
+    Shift-accumulate: 22 statically-sliced multiply-adds into one
+    [..., 46] accumulator. Ties the outer-product + pad/reshape
+    anti-diagonal formulation in on-chip speed but peaks at 2x the input
+    footprint instead of 22x (the [..., 22, 46] intermediate made wide
+    batched ops HBM-traffic-bound and OOM'd the 8k-sig merged dispatch —
+    PROFILE.md round 3). Static shapes; no gathers.
     """
-    outer = a[..., :, None] * b[..., None, :]  # [..., 22, 22], |.| < 2^28
-    padded = jnp.pad(
-        outer, [(0, 0)] * (outer.ndim - 2) + [(0, 0), (0, _NCOLS + 1 - LIMBS)]
-    )
-    flat = padded.reshape(*outer.shape[:-2], LIMBS * (_NCOLS + 1))
-    flat = flat[..., : LIMBS * _NCOLS]
-    return flat.reshape(*outer.shape[:-2], LIMBS, _NCOLS).sum(axis=-2)
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, (*batch, LIMBS))
+    b = jnp.broadcast_to(b, (*batch, LIMBS))
+    c = jnp.zeros((*batch, _NCOLS), dtype=a.dtype)
+    for i in range(LIMBS):
+        c = c.at[..., i : i + LIMBS].add(a[..., i : i + 1] * b)
+    return c
 
 
 def mul(a: jax.Array, b: jax.Array) -> jax.Array:

@@ -35,7 +35,10 @@ class ShardedTPUVerifier(TPUVerifier):
     """TPUVerifier whose device dispatch shards the batch over a mesh."""
 
     def __init__(self, registry: KeyRegistry, mesh: Optional[Mesh] = None):
-        super().__init__(registry)
+        # The sharded dispatch uses the windowed verify program (its
+        # argument layout shards cleanly); the single-chip comb fast path
+        # is selected by the plain TPUVerifier.
+        super().__init__(registry, comb=False)
         self.mesh = mesh if mesh is not None else make_mesh()
         self._n_shards = int(np.prod(self.mesh.devices.shape))
         sharding = batch_sharding(self.mesh)

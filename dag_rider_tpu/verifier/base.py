@@ -87,3 +87,12 @@ class Verifier(abc.ABC):
         """Accept mask, same order as input. Must be a pure function of
         (vertex bytes, registry) — no randomness — so CPU and TPU backends
         agree bit-for-bit."""
+
+    def verify_rounds(
+        self, rounds: Sequence[Sequence[Vertex]]
+    ) -> List[List[bool]]:
+        """Accept masks for several rounds' batches. Semantically
+        equivalent to mapping :meth:`verify_batch`; device backends
+        override this to merge the rounds into one padded dispatch
+        (amortizing the fixed per-dispatch cost — see PROFILE.md)."""
+        return [self.verify_batch(r) for r in rounds]

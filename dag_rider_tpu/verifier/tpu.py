@@ -40,17 +40,22 @@ from dag_rider_tpu.verifier.base import KeyRegistry, Verifier
 _MIN_BUCKET = 16
 
 
-def _native_enabled() -> bool:
-    """Native challenge hashing on by default; DAGRIDER_NATIVE=0 (or
-    false/no/off) disables — the hashlib fallback is always available."""
+def _env_flag(name: str, default: str = "1") -> bool:
+    """Shared env-flag convention: anything but 0/false/no/off is on."""
     import os
 
-    return os.environ.get("DAGRIDER_NATIVE", "1").lower() not in (
+    return os.environ.get(name, default).lower() not in (
         "0",
         "false",
         "no",
         "off",
     )
+
+
+def _native_enabled() -> bool:
+    """Native challenge hashing on by default; DAGRIDER_NATIVE=0 (or
+    false/no/off) disables — the hashlib fallback is always available."""
+    return _env_flag("DAGRIDER_NATIVE")
 
 
 def _bucket(n: int) -> int:
@@ -133,6 +138,65 @@ def _device_verify(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("impl",))
+def _device_verify_comb(
+    u8: jax.Array,
+    i32: jax.Array,
+    key_tables: jax.Array,
+    b_table: jax.Array,
+    impl: str = "jnp",
+) -> jax.Array:
+    """Unpack the two packed transfer arrays (see _prepare comb mode) and
+    run the comb verify core."""
+    from dag_rider_tpu.ops import comb
+
+    s_nibbles = u8[:, :64].astype(jnp.int32)
+    k_nibbles = u8[:, 64:128].astype(jnp.int32)
+    r_sign = u8[:, 128].astype(jnp.int32)
+    prevalid = u8[:, 129].astype(bool)
+    a_valid = u8[:, 130].astype(bool)
+    key_idx = i32[:, 0]
+    r_y = i32[:, 1:]
+    return comb.comb_verify_core(
+        s_nibbles,
+        k_nibbles,
+        key_idx,
+        key_tables,
+        b_table,
+        a_valid,
+        r_y,
+        r_sign,
+        prevalid,
+        impl=impl,
+    )
+
+
+_B_TABLE_CACHED: Optional[np.ndarray] = None
+
+
+def _b_table_cached() -> np.ndarray:
+    global _B_TABLE_CACHED
+    if _B_TABLE_CACHED is None:
+        from dag_rider_tpu.ops import comb
+
+        _B_TABLE_CACHED = comb.base_table_xyzt()
+    return _B_TABLE_CACHED
+
+
+def _comb_impl(size: int) -> str:
+    """Pallas kernels on a real TPU backend for lane-aligned batches;
+    portable jnp everywhere else. Both are bit-identical — this is purely
+    a speed selection (PROFILE.md round 3: the jnp tree is memory-bound
+    on HLO temps; the kernels do one HBM pass per operand). The axon
+    PJRT relay has registered the chip as platform "tpu" or "axon"
+    depending on plugin version — accept both."""
+    if not _env_flag("DAGRIDER_PALLAS_GROUP"):
+        return "jnp"
+    if size >= 128 and jax.default_backend() in ("tpu", "axon"):
+        return "pallas"
+    return "jnp"
+
+
 class TPUVerifier(Verifier):
     """Batched Ed25519 verification on the accelerator.
 
@@ -141,7 +205,17 @@ class TPUVerifier(Verifier):
     under the benchmark driver.
     """
 
-    def __init__(self, registry: KeyRegistry):
+    def __init__(self, registry: KeyRegistry, comb: Optional[bool] = None):
+        """``comb=True`` (the default, DAGRIDER_COMB=0 to flip) uses the
+        fixed-key comb path (ops/comb.py): per-key tables built on device
+        once, ~2.5x fewer field muls per signature, identical accept
+        masks. ``comb=False`` is the original windowed path — kept as the
+        differential oracle and for registries too large for table HBM
+        (~360 KB/key)."""
+        if comb is None:
+            comb = _env_flag("DAGRIDER_COMB")
+        self._comb = comb
+        self._key_tables = None  # device [n, 64, 16, 4, 22], built lazily
         self.registry = registry
         n = registry.n
         self._a_x = np.zeros((n, field.LIMBS), dtype=np.int32)
@@ -161,7 +235,7 @@ class TPUVerifier(Verifier):
     # -- host-side batch preparation ------------------------------------
 
     def _prepare(
-        self, vertices: Sequence[Vertex], size: int
+        self, vertices: Sequence[Vertex], size: int, comb: bool = False
     ) -> Tuple[np.ndarray, ...]:
         # Vectorized host prep (round-2 VERDICT weak #3: the per-vertex
         # Python loop must clear ~50k iterations/s at the north-star rate).
@@ -228,6 +302,21 @@ class TPUVerifier(Verifier):
         s_nib = nibbles_batch(np.where(prevalid[:, None], s_raw, 0))
         k_nib = nibbles_batch(k_raw)
         r_y_limbs = bytes_to_limbs_batch(r_raw)
+        if comb:
+            # Two transfers instead of seven: the relay's per-transfer
+            # latency is a large share of the fixed dispatch cost
+            # (PROFILE.md round 3). u8 carries digits + flag bits; i32
+            # carries key index + R.y limbs. Nibbles fit u8 exactly.
+            u8 = np.empty((size, 131), dtype=np.uint8)
+            u8[:, :64] = s_nib
+            u8[:, 64:128] = k_nib
+            u8[:, 128] = r_sign
+            u8[:, 129] = prevalid
+            u8[:, 130] = self._a_valid[src] & prevalid
+            i32 = np.empty((size, 23), dtype=np.int32)
+            i32[:, 0] = src
+            i32[:, 1:] = r_y_limbs
+            return (u8, i32)
         return (
             s_nib,
             k_nib,
@@ -240,10 +329,33 @@ class TPUVerifier(Verifier):
             prevalid,
         )
 
+    def _comb_tables(self):
+        """Device comb tables in the padded [rows, 128] gather layout
+        (built once, first dispatch) + the base-point table."""
+        if self._key_tables is None:
+            from dag_rider_tpu.ops import comb
+
+            built = comb.build_key_tables(
+                jnp.asarray(self._a_x),
+                jnp.asarray(self._a_y),
+                jnp.asarray(self._a_t),
+            )
+            self._key_tables = jax.jit(comb.pad_rows)(built)
+            self._b_table_dev = jax.jit(comb.pad_rows)(
+                jnp.asarray(_b_table_cached())
+            )
+        return self._key_tables, self._b_table_dev
+
     #: host-prep / device-dispatch seconds of the most recent
     #: verify_batch call — the host/device split the bench reports.
     last_prepare_s: float = 0.0
     last_dispatch_s: float = 0.0
+
+    #: When set, every dispatch pads to exactly this bucket (and
+    #: verify_rounds chunks larger merges into it) — ONE compiled program
+    #: shape for a whole consensus run, instead of a power-of-two ladder
+    #: of ~35 s XLA compiles as burst sizes wander (bench ladder sim64).
+    fixed_bucket: Optional[int] = None
 
     def dispatch_batch(self, vertices: Sequence[Vertex]):
         """Asynchronous half of verify: host prep + device dispatch, NO
@@ -251,14 +363,57 @@ class TPUVerifier(Verifier):
         :meth:`resolve_batch`. Lets a caller overlap round k+1's host prep
         with round k's device execution — the steady-state pipeline shape
         of burst delivery (one dispatch per DAG round)."""
-        size = _bucket(len(vertices))
+        if self.fixed_bucket and len(vertices) <= self.fixed_bucket:
+            size = self.fixed_bucket
+        else:
+            size = _bucket(len(vertices))
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.prepare"):
-            args = self._prepare(vertices, size)
+            args = self._prepare(vertices, size, comb=self._comb)
         self.last_prepare_s = time.perf_counter() - t0
         with jax.profiler.TraceAnnotation("verify_batch.dispatch"):
-            mask = _device_verify(*(jnp.asarray(a) for a in args))
+            if self._comb:
+                u8, i32 = args
+                tables, b_tab = self._comb_tables()
+                mask = _device_verify_comb(
+                    jnp.asarray(u8),
+                    jnp.asarray(i32),
+                    tables,
+                    b_tab,
+                    impl=_comb_impl(size),
+                )
+            else:
+                mask = _device_verify(*(jnp.asarray(a) for a in args))
         return mask, len(vertices)
+
+    def verify_rounds(
+        self, rounds: Sequence[Sequence[Vertex]]
+    ) -> List[List[bool]]:
+        """Verify several DAG rounds in ONE device dispatch.
+
+        The per-dispatch cost has a large fixed component (host-device
+        transfer latency dominates on relayed backends — see PROFILE.md),
+        amortized by merging consecutive rounds' batches into a single
+        padded dispatch and splitting the mask after. Used by the bench's
+        merged steady-state phase and available to catch-up sync / burst
+        consumers.
+        """
+        lens = [len(r) for r in rounds]
+        flat = [v for r in rounds for v in r]
+        if not flat:
+            return [[] for _ in rounds]
+        cap = self.fixed_bucket
+        if cap and len(flat) > cap:
+            mask = []
+            for i in range(0, len(flat), cap):
+                mask.extend(self.verify_batch(flat[i : i + cap]))
+        else:
+            mask = self.verify_batch(flat)
+        out, pos = [], 0
+        for ln in lens:
+            out.append(mask[pos : pos + ln])
+            pos += ln
+        return out
 
     @staticmethod
     def resolve_batch(pending) -> List[bool]:
