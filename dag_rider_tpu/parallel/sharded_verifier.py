@@ -26,22 +26,32 @@ from jax.sharding import Mesh
 
 from dag_rider_tpu.core.types import Vertex
 from dag_rider_tpu.ops import curve, field
-from dag_rider_tpu.parallel.mesh import batch_sharding, make_mesh
+from dag_rider_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
 from dag_rider_tpu.verifier.base import KeyRegistry
 from dag_rider_tpu.verifier.tpu import TPUVerifier
 
 
 class ShardedTPUVerifier(TPUVerifier):
-    """TPUVerifier whose device dispatch shards the batch over a mesh."""
+    """TPUVerifier whose device dispatch shards the batch over a mesh.
 
-    def __init__(self, registry: KeyRegistry, mesh: Optional[Mesh] = None):
-        # The sharded dispatch uses the windowed verify program (its
-        # argument layout shards cleanly); the single-chip comb fast path
-        # is selected by the plain TPUVerifier.
-        super().__init__(registry, comb=False)
+    Verification is embarrassingly data-parallel: every per-vertex input
+    (digits, key index, R.y) shards over the mesh's "batch" axis while
+    the comb tables replicate (every chip holds the registry's tables —
+    they are read-only and gather-indexed by the local shard's rows).
+    ``comb=False`` falls back to sharding the windowed program.
+    """
+
+    def __init__(
+        self,
+        registry: KeyRegistry,
+        mesh: Optional[Mesh] = None,
+        comb: Optional[bool] = None,
+    ):
+        super().__init__(registry, comb=comb)
         self.mesh = mesh if mesh is not None else make_mesh()
         self._n_shards = int(np.prod(self.mesh.devices.shape))
         sharding = batch_sharding(self.mesh)
+        repl = replicated(self.mesh)
 
         @functools.partial(
             jax.jit,
@@ -59,6 +69,21 @@ class ShardedTPUVerifier(TPUVerifier):
 
         self._sharded_verify = _sharded_verify
 
+        @functools.partial(
+            jax.jit,
+            in_shardings=(sharding, sharding, repl, repl),
+            out_shardings=sharding,
+            static_argnums=(4,),
+        )
+        def _sharded_verify_comb(u8, i32, key_tables, b_table, impl):
+            from dag_rider_tpu.verifier.tpu import _device_verify_comb
+
+            return _device_verify_comb.__wrapped__(
+                u8, i32, key_tables, b_table, impl=impl
+            )
+
+        self._sharded_verify_comb = _sharded_verify_comb
+
     def _bucket_size(self, n: int) -> int:
         # pad to a multiple of the mesh so every shard gets equal work
         b = self._n_shards
@@ -70,8 +95,21 @@ class ShardedTPUVerifier(TPUVerifier):
         if not vertices:
             return []
         size = self._bucket_size(len(vertices))
-        args = self._prepare(vertices, size)
-        mask = np.asarray(
-            self._sharded_verify(*(jnp.asarray(a) for a in args))
-        )
+        args = self._prepare(vertices, size, comb=self._comb)
+        if self._comb:
+            u8, i32 = args
+            tables, b_tab = self._comb_tables()
+            # Always the portable jnp tree here: Mosaic pallas_call
+            # kernels cannot lower under GSPMD auto-partitioning (they
+            # need an explicit shard_map, as parallel/msm.py does for the
+            # MSM kernel — the per-shard pallas comb is future work).
+            mask = np.asarray(
+                self._sharded_verify_comb(
+                    jnp.asarray(u8), jnp.asarray(i32), tables, b_tab, "jnp"
+                )
+            )
+        else:
+            mask = np.asarray(
+                self._sharded_verify(*(jnp.asarray(a) for a in args))
+            )
         return [bool(m) for m in mask[: len(vertices)]]

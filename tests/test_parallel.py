@@ -68,8 +68,9 @@ def test_sharded_mask_equals_single_device_and_cpu(keys, batch):
     reg, _ = keys
     cpu = CPUVerifier(reg).verify_batch(batch)
     tpu = TPUVerifier(reg).verify_batch(batch)
-    sharded = ShardedTPUVerifier(reg).verify_batch(batch)
-    assert cpu == tpu == sharded
+    sharded = ShardedTPUVerifier(reg).verify_batch(batch)  # comb tables
+    windowed = ShardedTPUVerifier(reg, comb=False).verify_batch(batch)
+    assert cpu == tpu == sharded == windowed
     assert sharded[:8] == [True] * 8
     assert sharded[8:] == [False] * 3
 
