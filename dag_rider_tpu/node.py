@@ -165,14 +165,21 @@ class Node:
         self.submit_interval = float(cfg.get("submit_interval_s", 0))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._submit_lock = threading.Lock()
+        self._submit_queue: list = []
 
         if self.ckpt_dir and checkpoint.latest_round(self.ckpt_dir) is not None:
             checkpoint.restore(self.process, self.ckpt_dir)
             self.log.event("restored", round=self.process.round)
 
     def submit(self, block: Block) -> None:
-        """Client API: enqueue a block for proposal (thread: pump's)."""
-        self.process.submit(block)
+        """Client API: enqueue a block for proposal. Thread-safe: the
+        block lands in a handoff queue the pump thread drains — Process
+        state is only ever touched from the pump thread (a caller-thread
+        process.submit racing the pump's step() corrupted state rarely
+        enough to be a flaky-suite heisenbug)."""
+        with self._submit_lock:
+            self._submit_queue.append(block)
 
     def start(self) -> None:
         self.process.defer_steps = True
@@ -184,6 +191,13 @@ class Node:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # The pump thread is down; flush any blocks still queued into the
+        # Process (safe from this thread now) so the shutdown checkpoint
+        # carries them — queued client submissions must not vanish.
+        try:
+            self._drain_submissions()
+        except Exception:  # noqa: BLE001 — shutdown must proceed
+            pass
         if self.ckpt_dir:
             checkpoint.save(self.process, self.ckpt_dir)
         self.net.close()
@@ -192,25 +206,58 @@ class Node:
         last_ckpt = last_submit = time.monotonic()
         seq = 0
         while not self._stop.is_set():
-            moved = self.net.pump(256)
-            self.process.step()
-            now = time.monotonic()
-            if self.submit_interval and now - last_submit >= self.submit_interval:
-                last_submit = now
-                seq += 1
-                self.process.submit(
-                    Block((f"n{self.process.index}-auto-{seq}".encode(),))
-                )
-            if (
-                self.ckpt_dir
-                and self.ckpt_every > 0
-                and now - last_ckpt >= self.ckpt_every
-            ):
-                last_ckpt = now
-                checkpoint.save(self.process, self.ckpt_dir)
-                self.log.event("checkpointed", round=self.process.round)
-            if not moved:
-                time.sleep(0.002)
+            try:
+                self._pump_once()
+                now = time.monotonic()
+                if (
+                    self.submit_interval
+                    and now - last_submit >= self.submit_interval
+                ):
+                    last_submit = now
+                    seq += 1
+                    self.process.submit(
+                        Block((f"n{self.process.index}-auto-{seq}".encode(),))
+                    )
+                if (
+                    self.ckpt_dir
+                    and self.ckpt_every > 0
+                    and now - last_ckpt >= self.ckpt_every
+                ):
+                    last_ckpt = now
+                    checkpoint.save(self.process, self.ckpt_dir)
+                    self.log.event("checkpointed", round=self.process.round)
+            except Exception as e:  # noqa: BLE001 — a BFT node must not
+                # die silently: before this guard, any exception
+                # (step, checkpoint IO, anything) killed the daemon pump
+                # thread and the node kept accepting traffic it never
+                # processed (observed as a stalled cluster with empty
+                # diagnostics).
+                self.process.metrics.inc("pump_errors")
+                self.log.event("pump_error", error=repr(e)[:200])
+                time.sleep(0.01)
+
+    def _drain_submissions(self) -> None:
+        """Move queued client blocks into the Process, one at a time; on
+        an exception the not-yet-processed remainder goes back to the
+        front of the queue (the failing block is dropped and logged —
+        retrying it forever would livelock the pump)."""
+        with self._submit_lock:
+            pending, self._submit_queue = self._submit_queue, []
+        while pending:
+            block = pending.pop(0)
+            try:
+                self.process.submit(block)
+            except Exception:
+                with self._submit_lock:
+                    self._submit_queue = pending + self._submit_queue
+                raise
+
+    def _pump_once(self) -> None:
+        self._drain_submissions()
+        moved = self.net.pump(256)
+        self.process.step()
+        if not moved:
+            time.sleep(0.002)
 
 
 # ----------------------------------------------------------------------

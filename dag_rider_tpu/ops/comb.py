@@ -251,12 +251,12 @@ def comb_verify_core(
         from dag_rider_tpu.ops import pallas_group
 
         acc = pallas_group.tree_sum_xyzt(entries)  # [B, 2, 4, 22]
-        pow_fn = pallas_group.pow22523_batch
-    else:
-        acc = tree_sum_packed(entries)
-        pow_fn = None
+        # decompress + rhs addition + projective equality in one launch
+        ok = pallas_group.finish_check(r_y, r_sign, acc)
+        return ok & a_valid & prevalid
+    acc = tree_sum_packed(entries)
     lhs = unpack_point(acc[:, 0])  # [s]B
     ka = unpack_point(acc[:, 1])  # [k]A
-    r_point, r_valid = curve.decompress(r_y, r_sign, pow_fn=pow_fn)
+    r_point, r_valid = curve.decompress(r_y, r_sign)
     rhs = curve.padd(r_point, ka)
     return curve.points_equal(lhs, rhs) & a_valid & r_valid & prevalid

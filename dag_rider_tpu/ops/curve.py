@@ -95,9 +95,7 @@ def points_equal(p: Point, q: Point) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def decompress(
-    y: jax.Array, sign: jax.Array, pow_fn=None
-) -> Tuple[Point, jax.Array]:
+def decompress(y: jax.Array, sign: jax.Array) -> Tuple[Point, jax.Array]:
     """Recover (x, y) from the y limbs + sign bit; returns (point, valid).
 
     Candidate square root of u/v computed as u v^3 (u v^7)^((p-5)/8)
@@ -110,20 +108,18 @@ def decompress(
     - parity(x) != sign                -> x := p - x
 
     The caller is responsible for the y < p canonicity check (done on the
-    host from the raw bytes, where it is one integer compare).
-
-    pow_fn optionally overrides the z^(2^252-3) chain (the Pallas
-    in-VMEM kernel on TPU); it must be bit-identical to F.pow22523.
+    host from the raw bytes, where it is one integer compare). (The TPU
+    fast path runs this whole routine inside the Pallas finish kernel —
+    ops/pallas_group.py _finish_kernel — this jnp version is the
+    portable twin and differential oracle.)
     """
-    if pow_fn is None:
-        pow_fn = F.pow22523
     one = jnp.broadcast_to(jnp.asarray(F.ONE), y.shape)
     y2 = F.square(y)
     u = F.sub(y2, one)                      # y^2 - 1
     v = F.add(F.mul(y2, jnp.asarray(F.D)), one)  # d y^2 + 1
     v3 = F.mul(F.square(v), v)
     v7 = F.mul(F.square(v3), v)
-    cand = F.mul(F.mul(u, v3), pow_fn(F.mul(u, v7)))
+    cand = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
     vxx = F.mul(v, F.square(cand))
     root1 = F.eq(vxx, u)
     root2 = F.eq(vxx, F.neg(u))

@@ -106,6 +106,66 @@ def _mul22(a: List, b) -> List:
 
 
 _D2_LIMBS = [int(v) for v in F.D2]
+_D_LIMBS = [int(v) for v in F.D]
+_SQRT_M1_LIMBS = [int(v) for v in F.SQRT_M1]
+_BIG_P = [int(v) for v in F.BIG_P]
+
+
+def _seq_carry_fold_rows(rows: List) -> List:
+    """In-kernel twin of field._seq_carry_fold (exact sequential pass)."""
+    carry_in = jnp.zeros_like(rows[0])
+    out = []
+    for i in range(L):
+        v = rows[i] + carry_in
+        out.append(v & F.LIMB_MASK)
+        carry_in = v >> F.LIMB_BITS
+    out[0] = out[0] + carry_in * F.TOP_FOLD
+    hi = out[L - 1] >> 3
+    out[L - 1] = out[L - 1] & 0x7
+    out[0] = out[0] + hi * 19
+    return out
+
+
+def _canon22(rows: List) -> List:
+    """In-kernel twin of field.canonical — unique representative mod p."""
+    rows = [r + _BIG_P[i] for i, r in enumerate(rows)]
+    for _ in range(3):
+        rows = _seq_carry_fold_rows(rows)
+    t = list(rows)
+    t[0] = t[0] + 19
+    carry_in = jnp.zeros_like(t[0])
+    tt = []
+    for i in range(L):
+        v = t[i] + carry_in
+        tt.append(v & F.LIMB_MASK)
+        carry_in = v >> F.LIMB_BITS
+    ge_p = (tt[L - 1] >> 3) > 0
+    tt[L - 1] = tt[L - 1] & 0x7
+    return [jnp.where(ge_p, tt[i], rows[i]) for i in range(L)]
+
+
+def _is_zero22(rows: List):
+    c = _canon22(rows)
+    acc = c[0] == 0
+    for i in range(1, L):
+        acc = acc & (c[i] == 0)
+    return acc
+
+
+def _eq22(a: List, b: List):
+    return _is_zero22(_sub22(a, b))
+
+
+def _parity22(rows: List):
+    return _canon22(rows)[0] & 1
+
+
+def _neg22(a: List) -> List:
+    return _carry2([-x for x in a])
+
+
+def _select22(cond, a: List, b: List) -> List:
+    return [jnp.where(cond, x, y) for x, y in zip(a, b)]
 
 
 def _read_point(ref) -> List[List]:
@@ -162,18 +222,12 @@ def _padd_xx_kernel(p_ref, q_ref, o_ref):
     _write_point(o_ref, _padd_core(p, qc))
 
 
-def _pow22523_kernel(z_ref, o_ref):
-    """z^(2^252 - 3): the RFC 8032 sqrt exponent chain, entirely in VMEM.
-
-    The jnp version is ~254 dependent [B, 22] ops that each round-trip
-    HBM; here the whole chain runs on one block's registers. fori_loop
-    keeps the Mosaic program small for the long square runs.
-    """
-    flat2d = len(z_ref.shape) == 2
-    if flat2d:
-        z = [z_ref[i : i + 1, :] for i in range(L)]
-    else:
-        z = [z_ref[i, 0] for i in range(L)]
+def _pow22523_rows(z: List) -> List:
+    """z^(2^252 - 3) on row lists — the RFC 8032 sqrt exponent chain,
+    entirely in VMEM. fori_loop keeps the Mosaic program small for the
+    long square runs; tuple carries, not stacked arrays (jnp.stack of 22
+    rows forced a VMEM relayout every iteration — the 250-deep chain
+    spent ~5x its multiply time shuffling, measured on-chip)."""
 
     def nsq(x: List, n: int) -> List:
         if n <= 4:
@@ -181,9 +235,6 @@ def _pow22523_kernel(z_ref, o_ref):
                 x = _mul22(x, x)
             return x
 
-        # Tuple carry, not a stacked array: jnp.stack of 22 rows forced a
-        # VMEM relayout every iteration (the 250-deep chain spent ~5x its
-        # multiply time shuffling — measured on-chip, PROFILE.md round 3).
         def body(_, rows):
             return tuple(_mul22(list(rows), list(rows)))
 
@@ -201,12 +252,82 @@ def _pow22523_kernel(z_ref, o_ref):
     t2 = _mul22(nsq(t1, 50), t1)            # 2^100 - 1
     t3 = _mul22(nsq(t2, 100), t2)           # 2^200 - 1
     t1 = _mul22(nsq(t3, 50), t1)            # 2^250 - 1
-    out = _mul22(nsq(t1, 2), z)             # 2^252 - 3
+    return _mul22(nsq(t1, 2), z)            # 2^252 - 3
+
+
+def _read_rows(ref, start: int, count: int) -> List:
+    if len(ref.shape) == 2:
+        return [ref[start + i : start + i + 1, :] for i in range(count)]
+    return [ref[start + i, 0] for i in range(count)]
+
+
+def _pow22523_kernel(z_ref, o_ref):
+    out = _pow22523_rows(_read_rows(z_ref, 0, L))
     for i in range(L):
-        if flat2d:
+        if len(o_ref.shape) == 2:
             o_ref[i : i + 1, :] = out[i]
         else:
             o_ref[i, 0] = out[i]
+
+
+def _finish_kernel(y_ref, sign_ref, acc_ref, o_ref):
+    """Everything after the comb trees, in ONE launch: R decompression
+    (incl. the sqrt chain), rhs = R + [k]A, and the projective equality
+    [s]B == rhs — the equality/parity tests each need an exact canonical
+    pass (22-step sequential carries), which as XLA ops were a long
+    dependent chain of tiny kernels.
+
+    y_ref: [22, T] R.y limbs; sign_ref: [1, T] sign bits;
+    acc_ref: [176, T] — rows 0..87 = [s]B (XYZT), 88..175 = [k]A.
+    o_ref: [1, T] int32 — 1 iff R decompressed valid AND lhs == rhs.
+    Ports curve.decompress + curve.padd + curve.points_equal exactly
+    (same decision tree; boolean output bit-identical by canonicality).
+    """
+    y = _read_rows(y_ref, 0, L)
+    sign = _read_rows(sign_ref, 0, 1)[0]
+    lhs = [_read_rows(acc_ref, c * L, L) for c in range(4)]
+    ka = [_read_rows(acc_ref, 88 + c * L, L) for c in range(4)]
+
+    one = [jnp.ones_like(y[0])] + [jnp.zeros_like(y[0])] * (L - 1)
+    y2 = _mul22(y, y)
+    u = _sub22(y2, one)
+    v = _add22(_mul22(y2, _D_LIMBS), one)
+    v3 = _mul22(_mul22(v, v), v)
+    v7 = _mul22(_mul22(v3, v3), v)
+    cand = _mul22(_mul22(u, v3), _pow22523_rows(_mul22(u, v7)))
+    vxx = _mul22(v, _mul22(cand, cand))
+    root1 = _eq22(vxx, u)
+    root2 = _eq22(vxx, _neg22(u))
+    x = _select22(root1, cand, _mul22(cand, _SQRT_M1_LIMBS))
+    valid = root1 | root2
+    x_zero = _is_zero22(x)
+    valid = valid & ~(x_zero & (sign == 1))
+    flip = _parity22(x) != sign
+    x = _select22(flip, _neg22(x), x)
+    r_point = [x, y, one, _mul22(x, y)]
+
+    # rhs = R + [k]A (complete addition, ka cached on the fly)
+    x2, y2k, z2, t2 = ka
+    qc = [
+        _sub22(y2k, x2),
+        _add22(y2k, x2),
+        _mul22(t2, _D2_LIMBS),
+        _dbl22(z2),
+    ]
+    rhs = _padd_core(r_point, qc)
+
+    # projective equality lhs == rhs
+    ex = _is_zero22(
+        _sub22(_mul22(lhs[0], rhs[2]), _mul22(rhs[0], lhs[2]))
+    )
+    ey = _is_zero22(
+        _sub22(_mul22(lhs[1], rhs[2]), _mul22(rhs[1], lhs[2]))
+    )
+    bit = (ex & ey & valid).astype(jnp.int32)
+    if len(o_ref.shape) == 2:
+        o_ref[0:1, :] = bit
+    else:
+        o_ref[0, 0] = bit
 
 
 # ---------------------------------------------------------------------------
@@ -224,36 +345,42 @@ def _block(n: int) -> int:
 _VREG = 8 * 128  # one (8, 128) int32 vector register's worth of lanes
 
 
-def _call_rowwise(kernel, rows: int, interpret: bool, *args: jax.Array):
-    """Run `kernel` over [rows, N] operands, blocked for full-vreg rows.
+def _call_rowwise(kernel, out_rows: int, interpret: bool, *args: jax.Array):
+    """Run `kernel` over [rows_i, N] operands, blocked for full-vreg rows.
 
-    When N divides into (8, 128) vregs the operands are viewed as
-    [rows, G, 8, 128] and each block is one vreg-shaped row set;
-    otherwise (tiny test sizes) a flat [rows, blk] 2D block is used.
+    Row counts may differ per operand (each arg's shape[0] is used); the
+    lane count N must match. When N divides into (8, 128) vregs the
+    operands are viewed as [rows, G, 8, 128] and each block is one
+    vreg-shaped row set; otherwise (tiny test sizes) a flat [rows, blk]
+    2D block is used.
     """
     n = args[0].shape[1]
     if n % _VREG == 0:
         g = n // _VREG
-        shaped = [a.reshape(rows, g, 8, 128) for a in args]
+        shaped = [a.reshape(a.shape[0], g, 8, 128) for a in args]
         out = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((rows, g, 8, 128), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((out_rows, g, 8, 128), jnp.int32),
             grid=(g,),
             in_specs=[
-                pl.BlockSpec((rows, 1, 8, 128), lambda i: (0, i, 0, 0))
-                for _ in args
+                pl.BlockSpec((a.shape[0], 1, 8, 128), lambda i: (0, i, 0, 0))
+                for a in args
             ],
-            out_specs=pl.BlockSpec((rows, 1, 8, 128), lambda i: (0, i, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (out_rows, 1, 8, 128), lambda i: (0, i, 0, 0)
+            ),
             interpret=interpret,
         )(*shaped)
-        return out.reshape(rows, n)
+        return out.reshape(out_rows, n)
     blk = _block(n)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((out_rows, n), jnp.int32),
         grid=(n // blk,),
-        in_specs=[pl.BlockSpec((rows, blk), lambda i: (0, i)) for _ in args],
-        out_specs=pl.BlockSpec((rows, blk), lambda i: (0, i)),
+        in_specs=[
+            pl.BlockSpec((a.shape[0], blk), lambda i: (0, i)) for a in args
+        ],
+        out_specs=pl.BlockSpec((out_rows, blk), lambda i: (0, i)),
         interpret=interpret,
     )(*args)
 
@@ -266,18 +393,29 @@ def padd_xx(p: jax.Array, q: jax.Array, *, interpret: bool = False) -> jax.Array
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pow22523(z: jax.Array, *, interpret: bool = False) -> jax.Array:
-    """z: int32[22, N] -> z^(2^252-3): one launch, zero HBM between muls."""
+    """z: int32[22, N] -> z^(2^252-3): one launch, zero HBM between muls.
+
+    The production path runs this chain inside :func:`finish_check`'s
+    kernel; this standalone entry exists for benchmarking and as the
+    kernel-level unit under test."""
     return _call_rowwise(_pow22523_kernel, L, interpret, z)
 
 
-def pow22523_batch(z: jax.Array, *, interpret: bool = False) -> jax.Array:
-    """Drop-in twin of F.pow22523 for [..., 22] batches (transposes to
-    limb-major, one kernel launch, transposes back)."""
-    batch_shape = z.shape[:-1]
-    flat = int(np.prod(batch_shape)) if batch_shape else 1
-    zt = jnp.moveaxis(z.reshape(flat, L), 0, 1)
-    out = pow22523(zt, interpret=interpret)
-    return jnp.moveaxis(out, 0, 1).reshape(*batch_shape, L)
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def finish_check(
+    r_y: jax.Array, r_sign: jax.Array, acc: jax.Array, *, interpret: bool = False
+) -> jax.Array:
+    """The post-tree tail of comb verification as ONE kernel launch.
+
+    r_y: int32[B, 22]; r_sign: int32[B]; acc: int32[B, 2, 4, 22]
+    (axis 1 = ([s]B, [k]A)). Returns bool[B]: R valid AND [s]B == R+[k]A.
+    """
+    b = r_y.shape[0]
+    y_t = jnp.moveaxis(r_y, 0, 1)  # [22, B]
+    sign_t = r_sign.reshape(1, b)
+    acc_t = jnp.moveaxis(acc.reshape(b, 8, L), 0, -1).reshape(8 * L, b)
+    out = _call_rowwise(_finish_kernel, 1, interpret, y_t, sign_t, acc_t)
+    return out.reshape(b).astype(bool)
 
 
 def tree_sum_xyzt(entries: jax.Array, *, interpret: bool = False) -> jax.Array:

@@ -98,6 +98,82 @@ def test_pow22523_kernel_matches_field():
     assert (out.arr == np.moveaxis(want, 0, 1)).all()
 
 
+def test_finish_kernel_matches_jnp_tail():
+    """_finish_kernel (decompress + rhs add + projective equality) vs the
+    jnp tail, on real signature data: a valid case, a wrong-lhs case, a
+    non-square y (no root), and the x==0-with-sign-bit reject arm."""
+    import jax
+
+    from dag_rider_tpu.crypto import ed25519
+    from dag_rider_tpu.ops import curve
+
+    sk, pk = host.generate_keypair(b"\x07" * 32)
+    msg = b"finish-kernel-test"
+    sig = host.sign(sk, msg)
+    a_pt = host.point_decompress(pk)
+    r_y_int = int.from_bytes(sig[:32], "little")
+    r_sign = r_y_int >> 255
+    r_y_int &= (1 << 255) - 1
+    s = int.from_bytes(sig[32:], "little")
+    import hashlib
+
+    k = (
+        int.from_bytes(
+            hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
+        )
+        % ed25519.L
+    )
+    lhs_pt = host.scalar_mult(s, host.B)
+    ka_pt = host.scalar_mult(k, a_pt)
+
+    def limbs(pt):
+        X, Y, Z, T = pt
+        return np.stack(
+            [
+                F.to_limbs(X % F.P_INT),
+                F.to_limbs(Y % F.P_INT),
+                F.to_limbs(Z % F.P_INT),
+                F.to_limbs(T % F.P_INT),
+            ]
+        )
+
+    cases = []  # (y_limbs, sign, lhs, ka)
+    cases.append((F.to_limbs(r_y_int), r_sign, limbs(lhs_pt), limbs(ka_pt)))
+    # wrong lhs: equality must fail
+    cases.append(
+        (F.to_limbs(r_y_int), r_sign, limbs(host.B), limbs(ka_pt))
+    )
+    # y with no curve point (2 is a non-square candidate on this curve)
+    cases.append((F.to_limbs(2), 0, limbs(lhs_pt), limbs(ka_pt)))
+    # x == 0 with sign bit set: y = 1 gives x = 0; sign 1 must reject
+    cases.append((F.to_limbs(1), 1, limbs(lhs_pt), limbs(ka_pt)))
+
+    m = len(cases)
+    y_t = np.zeros((22, m), np.int32)
+    sign_t = np.zeros((1, m), np.int32)
+    acc = np.zeros((m, 2, 4, 22), np.int32)
+    for j, (y, sg, lhs, ka) in enumerate(cases):
+        y_t[:, j] = y
+        sign_t[0, j] = sg
+        acc[j, 0] = lhs
+        acc[j, 1] = ka
+    acc_t = np.moveaxis(acc.reshape(m, 8, 22), 0, -1).reshape(176, m)
+    out = _Ref(np.zeros((1, m), np.int32))
+    PG._finish_kernel(_Ref(y_t), _Ref(sign_t), _Ref(acc_t), out)
+    got = out.arr[0].astype(bool)
+
+    jacc = jnp.asarray(acc)
+    r_pt, r_valid = curve.decompress(
+        jnp.asarray(y_t.T), jnp.asarray(sign_t[0])
+    )
+    rhs = curve.padd(r_pt, comb.unpack_point(jacc[:, 1]))
+    want = np.asarray(
+        curve.points_equal(comb.unpack_point(jacc[:, 0]), rhs) & r_valid
+    )
+    assert (got == want).all()
+    assert got.tolist() == [True, False, False, False]
+
+
 def test_tree_pairing_matches_jnp_tree():
     # The tree pairs first half + second half each level in both
     # implementations; replay the pallas pairing with kernel-body calls
