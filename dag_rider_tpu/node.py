@@ -191,13 +191,23 @@ class Node:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # A wedged pump thread may still be mutating Process;
+                # draining or checkpointing from this thread would race
+                # it (and persist a mid-mutation snapshot). Leave state
+                # alone and just tear the transport down.
+                self.log.event("stop_pump_hung")
+                self.net.close()
+                return
         # The pump thread is down; flush any blocks still queued into the
         # Process (safe from this thread now) so the shutdown checkpoint
         # carries them — queued client submissions must not vanish.
         try:
             self._drain_submissions()
-        except Exception:  # noqa: BLE001 — shutdown must proceed
-            pass
+        except Exception as e:  # noqa: BLE001 — shutdown must proceed,
+            # but never silently: the dropped block and stranded
+            # remainder need a trace.
+            self.log.event("stop_drain_error", error=repr(e)[:200])
         if self.ckpt_dir:
             checkpoint.save(self.process, self.ckpt_dir)
         self.net.close()
