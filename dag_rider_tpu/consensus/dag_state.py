@@ -69,12 +69,16 @@ class DagState:
             raise ValueError(f"vertex {v.id} already present")
         self.vertices[v.id] = v
         self.exists[v.round, v.source] = True
+        prev_round = v.round - 1
         for e in v.strong_edges:
-            if e.round != v.round - 1:
+            if e.round != prev_round:
                 raise ValueError(
-                    f"strong edge {e} from {v.id} must target round {v.round - 1}"
+                    f"strong edge {e} from {v.id} must target round {prev_round}"
                 )
-            self.strong[v.round, v.source, e.source] = True
+        # one fancy-index write instead of ~2f+1 numpy scalar stores
+        self.strong[v.round, v.source, [e.source for e in v.strong_edges]] = (
+            True
+        )
         if v.weak_edges:
             self.weak[(v.round, v.source)] = tuple(
                 (e.round, e.source) for e in v.weak_edges
@@ -86,10 +90,13 @@ class DagState:
 
     def present(self, vid: VertexID) -> bool:
         """Membership — the reference's ``present`` full-DAG scan
-        (``process/process.go:373-384``), here O(1)."""
-        if vid.round >= self._capacity or vid.round < 0:
-            return False
-        return bool(self.exists[vid.round, vid.source])
+        (``process/process.go:373-384``), here O(1).
+
+        Dict lookup, not the dense mirror: ``exists`` is only ever set by
+        :meth:`insert`, which also fills ``vertices``, so the two agree —
+        and a numpy scalar index costs ~8x a (hash-cached) dict probe,
+        on the hottest call in the 64-node consensus profile."""
+        return vid in self.vertices
 
     def get(self, vid: VertexID) -> Optional[Vertex]:
         return self.vertices.get(vid)

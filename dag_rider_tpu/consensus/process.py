@@ -195,18 +195,24 @@ class Process:
         # (process.go:164-168), all targeting round-1, all sources in-range.
         # A Byzantine vertex must not be able to index outside [0, n)
         # (negative sources would silently alias via numpy wraparound).
-        if (
-            len(set(v.strong_edges)) < self.cfg.quorum
-            or any(
-                e.round != v.round - 1 or not 0 <= e.source < self.cfg.n
-                for e in v.strong_edges
-            )
-            or any(
-                not (1 <= e.round <= v.round - 2)
-                or not 0 <= e.source < self.cfg.n
-                for e in v.weak_edges
-            )
-        ):
+        # (Plain loops with hoisted locals: this gate runs once per
+        # received vertex and the generator-expression version was a
+        # visible slice of the 64-node profile.)
+        vr = v.id.round
+        n_cfg = self.cfg.n
+        bad_edges = len(set(v.strong_edges)) < self.cfg.quorum
+        if not bad_edges:
+            prev_round = vr - 1
+            for e in v.strong_edges:
+                if e.round != prev_round or not 0 <= e.source < n_cfg:
+                    bad_edges = True
+                    break
+        if not bad_edges:
+            for e in v.weak_edges:
+                if not (1 <= e.round <= vr - 2) or not 0 <= e.source < n_cfg:
+                    bad_edges = True
+                    break
+        if bad_edges:
             self.metrics.inc("msgs_rejected_edges")
             self.log.event(
                 "reject_edges",
@@ -305,22 +311,30 @@ class Process:
         """
         admitted_any = False
         changed = True
+        present = self.dag.present
         while changed:
             changed = False
             keep: List[Vertex] = []
             for v in self.buffer:
-                if v.round > self.round:
+                if v.id.round > self.round:
                     keep.append(v)
                     continue
-                if self.dag.present(v.id):
+                if present(v.id):
                     # raced in via another path; drop rather than re-insert
                     self._buffered_ids.discard(v.id)
                     self.metrics.inc("msgs_duplicate")
                     changed = True
                     continue
-                preds_present = all(
-                    self.dag.present(e) for e in v.strong_edges
-                ) and all(self.dag.present(e) for e in v.weak_edges)
+                preds_present = True
+                for e in v.strong_edges:
+                    if not present(e):
+                        preds_present = False
+                        break
+                if preds_present:
+                    for e in v.weak_edges:
+                        if not present(e):
+                            preds_present = False
+                            break
                 if preds_present:
                     self.dag.insert(v)
                     self._buffered_ids.discard(v.id)

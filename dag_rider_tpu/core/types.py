@@ -37,6 +37,15 @@ class VertexID:
     round: int
     source: int
 
+    def __post_init__(self):
+        # VertexID is hashed millions of times per consensus run (set
+        # membership in buffers, dedup sets, dag mirrors); the generated
+        # dataclass __hash__ builds a tuple per call. Precompute once.
+        object.__setattr__(self, "_hash", hash((self.round, self.source)))
+
+    def __hash__(self) -> int:
+        return self._hash
+
     def encode(self) -> bytes:
         return struct.pack("<II", self.round, self.source)
 

@@ -48,6 +48,10 @@ class ShardedTPUVerifier(TPUVerifier):
         comb: Optional[bool] = None,
     ):
         super().__init__(registry, comb=comb)
+        # Replicating the 8-bit tables (1.07 GB at n=256) on every chip
+        # is the wrong trade for a mesh; the sharded comb program is
+        # pinned to 4-bit windows.
+        self._comb_bits = 4
         self.mesh = mesh if mesh is not None else make_mesh()
         self._n_shards = int(np.prod(self.mesh.devices.shape))
         sharding = batch_sharding(self.mesh)

@@ -171,6 +171,39 @@ def _device_verify_comb(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("impl",))
+def _device_verify_comb8(
+    u8: jax.Array,
+    i32: jax.Array,
+    key_tables: jax.Array,
+    b_table: jax.Array,
+    impl: str = "jnp",
+) -> jax.Array:
+    """8-bit-window twin of :func:`_device_verify_comb` — u8 carries raw
+    scalar BYTES (32+32) instead of nibble digits."""
+    from dag_rider_tpu.ops import comb
+
+    s_bytes = u8[:, :32].astype(jnp.int32)
+    k_bytes = u8[:, 32:64].astype(jnp.int32)
+    r_sign = u8[:, 64].astype(jnp.int32)
+    prevalid = u8[:, 65].astype(bool)
+    a_valid = u8[:, 66].astype(bool)
+    key_idx = i32[:, 0]
+    r_y = i32[:, 1:]
+    return comb.comb_verify_core8(
+        s_bytes,
+        k_bytes,
+        key_idx,
+        key_tables,
+        b_table,
+        a_valid,
+        r_y,
+        r_sign,
+        prevalid,
+        impl=impl,
+    )
+
+
 _B_TABLE_CACHED: Optional[np.ndarray] = None
 
 
@@ -181,6 +214,28 @@ def _b_table_cached() -> np.ndarray:
 
         _B_TABLE_CACHED = comb.base_table_xyzt()
     return _B_TABLE_CACHED
+
+
+_B_TABLE8_DEV = None
+
+
+def _b_table8_dev():
+    """8-bit base-point table (registry-independent, device-resident) —
+    built once per process through the same device builder on a one-key
+    "registry" holding B itself."""
+    global _B_TABLE8_DEV
+    if _B_TABLE8_DEV is None:
+        from dag_rider_tpu.crypto import ed25519
+        from dag_rider_tpu.ops import comb, field
+
+        bx, by, _, bt = ed25519.B
+        built = comb.build_key_tables8(
+            jnp.asarray(field.to_limbs(bx)[None]),
+            jnp.asarray(field.to_limbs(by)[None]),
+            jnp.asarray(field.to_limbs(bt)[None]),
+        )[0]
+        _B_TABLE8_DEV = jax.jit(comb.pad_rows)(built)
+    return _B_TABLE8_DEV
 
 
 def _comb_impl(size: int) -> str:
@@ -212,10 +267,24 @@ class TPUVerifier(Verifier):
         masks. ``comb=False`` is the original windowed path — kept as the
         differential oracle and for registries too large for table HBM
         (~360 KB/key)."""
+        import os
+
         if comb is None:
             comb = _env_flag("DAGRIDER_COMB")
         self._comb = comb
-        self._key_tables = None  # device [n, 64, 16, 4, 22], built lazily
+        # Window width. 8-bit tables halve the gather rows and tree
+        # levels but cost 16x the HBM (1.07 GB padded at n=256) and
+        # measured NO faster on the relay (56.6k vs 62.0k sigs/s at 16k
+        # merged — the bigger table's gather locality eats the row-count
+        # saving; PROFILE.md round 3), so 4-bit is the default and 8-bit
+        # stays as a correct, tested variant (DAGRIDER_COMB_BITS=8).
+        bits_env = os.environ.get("DAGRIDER_COMB_BITS", "").strip()
+        if bits_env and bits_env not in ("4", "8"):
+            raise ValueError(
+                f"DAGRIDER_COMB_BITS must be 4 or 8, got {bits_env!r}"
+            )
+        self._comb_bits = int(bits_env) if bits_env else 4
+        self._key_tables = None  # device tables, built lazily
         self.registry = registry
         n = registry.n
         self._a_x = np.zeros((n, field.LIMBS), dtype=np.int32)
@@ -299,27 +368,36 @@ class TPUVerifier(Verifier):
                     k_raw[j] = np.frombuffer(
                         k.to_bytes(32, "little"), dtype=np.uint8
                     )
-        s_nib = nibbles_batch(np.where(prevalid[:, None], s_raw, 0))
-        k_nib = nibbles_batch(k_raw)
         r_y_limbs = bytes_to_limbs_batch(r_raw)
         if comb:
             # Two transfers instead of seven: the relay's per-transfer
             # latency is a large share of the fixed dispatch cost
             # (PROFILE.md round 3). u8 carries digits + flag bits; i32
-            # carries key index + R.y limbs. Nibbles fit u8 exactly.
-            u8 = np.empty((size, 131), dtype=np.uint8)
-            u8[:, :64] = s_nib
-            u8[:, 64:128] = k_nib
-            u8[:, 128] = r_sign
-            u8[:, 129] = prevalid
-            u8[:, 130] = self._a_valid[src] & prevalid
+            # carries key index + R.y limbs. 8-bit windows ship the raw
+            # scalar bytes; 4-bit ships nibble digits.
+            if self._comb_bits == 8:
+                u8 = np.empty((size, 67), dtype=np.uint8)
+                u8[:, :32] = np.where(prevalid[:, None], s_raw, 0)
+                u8[:, 32:64] = k_raw
+                u8[:, 64] = r_sign
+                u8[:, 65] = prevalid
+                u8[:, 66] = self._a_valid[src] & prevalid
+            else:
+                u8 = np.empty((size, 131), dtype=np.uint8)
+                u8[:, :64] = nibbles_batch(
+                    np.where(prevalid[:, None], s_raw, 0)
+                )
+                u8[:, 64:128] = nibbles_batch(k_raw)
+                u8[:, 128] = r_sign
+                u8[:, 129] = prevalid
+                u8[:, 130] = self._a_valid[src] & prevalid
             i32 = np.empty((size, 23), dtype=np.int32)
             i32[:, 0] = src
             i32[:, 1:] = r_y_limbs
             return (u8, i32)
         return (
-            s_nib,
-            k_nib,
+            nibbles_batch(np.where(prevalid[:, None], s_raw, 0)),
+            nibbles_batch(k_raw),
             self._a_x[src],
             self._a_y[src],
             self._a_t[src],
@@ -335,15 +413,23 @@ class TPUVerifier(Verifier):
         if self._key_tables is None:
             from dag_rider_tpu.ops import comb
 
-            built = comb.build_key_tables(
-                jnp.asarray(self._a_x),
-                jnp.asarray(self._a_y),
-                jnp.asarray(self._a_t),
-            )
+            if self._comb_bits == 8:
+                built = comb.build_key_tables8(
+                    jnp.asarray(self._a_x),
+                    jnp.asarray(self._a_y),
+                    jnp.asarray(self._a_t),
+                )
+                self._b_table_dev = _b_table8_dev()
+            else:
+                built = comb.build_key_tables(
+                    jnp.asarray(self._a_x),
+                    jnp.asarray(self._a_y),
+                    jnp.asarray(self._a_t),
+                )
+                self._b_table_dev = jax.jit(comb.pad_rows)(
+                    jnp.asarray(_b_table_cached())
+                )
             self._key_tables = jax.jit(comb.pad_rows)(built)
-            self._b_table_dev = jax.jit(comb.pad_rows)(
-                jnp.asarray(_b_table_cached())
-            )
         return self._key_tables, self._b_table_dev
 
     #: host-prep / device-dispatch seconds of the most recent
@@ -375,7 +461,12 @@ class TPUVerifier(Verifier):
             if self._comb:
                 u8, i32 = args
                 tables, b_tab = self._comb_tables()
-                mask = _device_verify_comb(
+                fn = (
+                    _device_verify_comb8
+                    if self._comb_bits == 8
+                    else _device_verify_comb
+                )
+                mask = fn(
                     jnp.asarray(u8),
                     jnp.asarray(i32),
                     tables,

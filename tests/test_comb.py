@@ -52,13 +52,16 @@ def _adversarial(vs):
     ]
 
 
-def test_comb_mask_matches_windowed_and_cpu(setup):
+def test_comb_mask_matches_windowed_and_cpu(setup, monkeypatch):
     reg, vs = setup
     batch = vs + _adversarial(vs)
     cpu = CPUVerifier(reg).verify_batch(batch)
     windowed = TPUVerifier(reg, comb=False).verify_batch(batch)
-    combed = TPUVerifier(reg, comb=True).verify_batch(batch)
-    assert cpu == windowed == combed
+    monkeypatch.setenv("DAGRIDER_COMB_BITS", "4")
+    comb4 = TPUVerifier(reg, comb=True).verify_batch(batch)
+    monkeypatch.setenv("DAGRIDER_COMB_BITS", "8")
+    comb8 = TPUVerifier(reg, comb=True).verify_batch(batch)
+    assert cpu == windowed == comb4 == comb8
     assert cpu[: len(vs)] == [True] * len(vs)
     assert not any(cpu[len(vs) :])
 
@@ -73,30 +76,53 @@ def test_verify_rounds_merged_matches_per_round(setup):
     assert merged[1] == []
 
 
-def test_comb_key_table_entries_match_host(setup):
-    """Spot-check device-built comb tables: TABLE[key, w, d] == d*16^w*A."""
+def _affine(p4x22):
+    from dag_rider_tpu.ops import field as F
+
+    X = F.from_limbs(p4x22[0]) % F.P_INT
+    Y = F.from_limbs(p4x22[1]) % F.P_INT
+    Z = F.from_limbs(p4x22[2]) % F.P_INT
+    zi = pow(Z, F.P_INT - 2, F.P_INT)
+    return X * zi % F.P_INT, Y * zi % F.P_INT
+
+
+def _host_affine(pt):
+    from dag_rider_tpu.ops import field as F
+
+    X, Y, Z, _ = pt
+    zi = pow(Z, F.P_INT - 2, F.P_INT)
+    return X * zi % F.P_INT, Y * zi % F.P_INT
+
+
+def test_comb_key_table_entries_match_host(setup, monkeypatch):
+    """Spot-check device-built comb tables: TABLE[key, w, d] == d*base^w*A
+    for both the 4-bit and 8-bit window builders."""
     import numpy as np
 
     from dag_rider_tpu.crypto import ed25519 as host
     from dag_rider_tpu.ops import field as F
 
     reg, _ = setup
+    monkeypatch.setenv("DAGRIDER_COMB_BITS", "4")
     tv = TPUVerifier(reg, comb=True)
     tables, _ = tv._comb_tables()  # padded [rows, 128] gather layout
     tab = np.asarray(tables)[:, : 4 * F.LIMBS].reshape(
         reg.n, 64, 16, 4, F.LIMBS
     )
-
-    def affine(p4x22):
-        X = F.from_limbs(p4x22[0]) % F.P_INT
-        Y = F.from_limbs(p4x22[1]) % F.P_INT
-        Z = F.from_limbs(p4x22[2]) % F.P_INT
-        zi = pow(Z, F.P_INT - 2, F.P_INT)
-        return X * zi % F.P_INT, Y * zi % F.P_INT
-
     for key, w, d in [(0, 0, 1), (1, 0, 7), (2, 3, 15), (5, 63, 9)]:
         a_pt = host.point_decompress(reg.public_keys[key])
-        X, Y, Z, _ = host.scalar_mult(d * (16**w), a_pt)
-        zi = pow(Z, F.P_INT - 2, F.P_INT)
-        want = (X * zi % F.P_INT, Y * zi % F.P_INT)
-        assert affine(tab[key, w, d]) == want, (key, w, d)
+        want = _host_affine(host.scalar_mult(d * (16**w), a_pt))
+        assert _affine(tab[key, w, d]) == want, (key, w, d)
+
+    monkeypatch.setenv("DAGRIDER_COMB_BITS", "8")
+    tv8 = TPUVerifier(reg, comb=True)
+    tables8, _ = tv8._comb_tables()
+    tab8 = np.asarray(tables8)[:, : 4 * F.LIMBS].reshape(
+        reg.n, 32, 256, 4, F.LIMBS
+    )
+    from dag_rider_tpu.ops.comb import DIGIT_POS8
+
+    for key, w, d in [(0, 0, 1), (1, 0, 255), (3, 2, 17), (5, 31, 128)]:
+        a_pt = host.point_decompress(reg.public_keys[key])
+        want = _host_affine(host.scalar_mult(d * (256**w), a_pt))
+        assert _affine(tab8[key, w, DIGIT_POS8[d]]) == want, (key, w, d)
