@@ -92,6 +92,9 @@ class Process:
         self.round = 0
         self.buffer: List[Vertex] = []
         self._buffered_ids: Set[VertexID] = set()
+        #: blocked-vertex memo for _drain_buffer's short-circuit; entries
+        #: live exactly as long as the vertex sits in the buffer.
+        self._blocked_on: Dict[VertexID, VertexID] = {}
         self._pending_verify: List[Vertex] = []
         self._pending_verify_ids: Set[VertexID] = set()
         self._waves_tried: Set[int] = set()
@@ -312,6 +315,12 @@ class Process:
         admitted_any = False
         changed = True
         present = self.dag.present
+        # Short-circuit memo: the first still-missing predecessor seen for
+        # each blocked vertex. While that one vertex is absent the full
+        # ~2f+1-edge scan must fail too, so repeated drain passes check
+        # ONE id instead of every edge (identical admission decisions —
+        # the memo only skips work when the outcome is already known).
+        blocked = self._blocked_on
         while changed:
             changed = False
             keep: List[Vertex] = []
@@ -322,20 +331,28 @@ class Process:
                 if present(v.id):
                     # raced in via another path; drop rather than re-insert
                     self._buffered_ids.discard(v.id)
+                    blocked.pop(v.id, None)
                     self.metrics.inc("msgs_duplicate")
                     changed = True
+                    continue
+                bp = blocked.get(v.id)
+                if bp is not None and not present(bp):
+                    keep.append(v)
                     continue
                 preds_present = True
                 for e in v.strong_edges:
                     if not present(e):
                         preds_present = False
+                        blocked[v.id] = e
                         break
                 if preds_present:
                     for e in v.weak_edges:
                         if not present(e):
                             preds_present = False
+                            blocked[v.id] = e
                             break
                 if preds_present:
+                    blocked.pop(v.id, None)
                     self.dag.insert(v)
                     self._buffered_ids.discard(v.id)
                     self.metrics.inc("vertices_admitted")

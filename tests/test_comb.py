@@ -66,6 +66,13 @@ def test_comb_mask_matches_windowed_and_cpu(setup, monkeypatch):
     assert not any(cpu[len(vs) :])
 
 
+def test_invalid_comb_bits_env_rejected(setup, monkeypatch):
+    reg, _ = setup
+    monkeypatch.setenv("DAGRIDER_COMB_BITS", "16")
+    with pytest.raises(ValueError, match="DAGRIDER_COMB_BITS"):
+        TPUVerifier(reg, comb=True)
+
+
 def test_verify_rounds_merged_matches_per_round(setup):
     reg, vs = setup
     v = TPUVerifier(reg, comb=True)
