@@ -66,6 +66,47 @@ def test_comb_mask_matches_windowed_and_cpu(setup, monkeypatch):
     assert not any(cpu[len(vs) :])
 
 
+def test_comb_fuzz_masks_match_cpu_oracle(setup):
+    """Seeded corruption fuzz: random byte/bit damage across signature,
+    R, key index, block and edges must always produce the oracle's mask
+    through the comb path (the north-star equivalence is only as strong
+    as its behavior on garbage)."""
+    import random
+
+    reg, vs = setup
+    rng = random.Random(1234)
+    tv = TPUVerifier(reg, comb=True)
+    cpu = CPUVerifier(reg)
+    batch = []
+    for _ in range(24):
+        v = rng.choice(vs)
+        mode = rng.randrange(5)
+        if mode == 0:  # signature damage
+            sig = bytearray(v.signature)
+            for _ in range(rng.randrange(1, 4)):
+                sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            v = dataclasses.replace(v, signature=bytes(sig))
+        elif mode == 1:  # payload damage (signed bytes change)
+            v = dataclasses.replace(
+                v, block=Block((rng.randbytes(rng.randrange(1, 40)),))
+            )
+        elif mode == 2:  # source redirect (wrong key)
+            v = dataclasses.replace(
+                v, id=VertexID(v.id.round, rng.randrange(reg.n))
+            )
+        elif mode == 3:  # edge tamper
+            v = dataclasses.replace(
+                v,
+                strong_edges=tuple(
+                    VertexID(e.round, (e.source + 1) % reg.n)
+                    for e in v.strong_edges
+                ),
+            )
+        # mode 4: leave valid
+        batch.append(v)
+    assert tv.verify_batch(batch) == cpu.verify_batch(batch)
+
+
 def test_invalid_comb_bits_env_rejected(setup, monkeypatch):
     reg, _ = setup
     monkeypatch.setenv("DAGRIDER_COMB_BITS", "16")
