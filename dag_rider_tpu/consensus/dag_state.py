@@ -39,6 +39,20 @@ class DagState:
         # weak[(r, i)] -> tuple of (r2, j) targets, r2 < r-1.
         self.weak: Dict[Tuple[int, int], Tuple[Tuple[int, int], ...]] = {}
         self.vertices: Dict[VertexID, Vertex] = {}
+        #: per-round {source: Vertex} mirror of `vertices` (fast
+        #: round_size / vertices_in_round without dense-row scans)
+        self._round_vertices: Dict[int, Dict[int, Vertex]] = {}
+        self.max_round = 0
+
+    def reset(self) -> None:
+        """Empty every mirror (used by checkpoint restore before
+        re-inserting in round order — keeps the mirrors' consistency
+        logic in one place instead of field-poking from callers)."""
+        self.vertices.clear()
+        self._round_vertices.clear()
+        self.exists[:] = False
+        self.strong[:] = False
+        self.weak.clear()
         self.max_round = 0
 
     # -- growth ------------------------------------------------------------
@@ -68,6 +82,7 @@ class DagState:
         if self.exists[v.round, v.source]:
             raise ValueError(f"vertex {v.id} already present")
         self.vertices[v.id] = v
+        self._round_vertices.setdefault(v.round, {})[v.source] = v
         self.exists[v.round, v.source] = True
         prev_round = v.round - 1
         for e in v.strong_edges:
@@ -102,17 +117,17 @@ class DagState:
         return self.vertices.get(vid)
 
     def round_size(self, rnd: int) -> int:
-        if rnd >= self._capacity:
-            return 0
-        return int(self.exists[rnd].sum())
+        return len(self._round_vertices.get(rnd, ()))
 
     def vertices_in_round(self, rnd: int) -> List[Vertex]:
-        if rnd >= self._capacity:
+        """Vertices of one round in ascending-source order (the
+        deterministic order proposals and total-order delivery rely on).
+        Served from the per-round dict mirror — the dense-row scan built
+        a VertexID per occupied slot on one of the hottest query paths."""
+        d = self._round_vertices.get(rnd)
+        if not d:
             return []
-        return [
-            self.vertices[VertexID(rnd, i)]
-            for i in np.flatnonzero(self.exists[rnd])
-        ]
+        return [d[s] for s in sorted(d)]
 
     def closure(
         self, seeds: Iterable[VertexID], strong_only: bool = False

@@ -98,11 +98,7 @@ def restore(process, path: str) -> None:
         offset += ln
         (buffered if tag & 0x80000000 else admitted).append(v)
     # Rebuild the DAG in round order so insert()'s invariants hold.
-    process.dag.vertices.clear()
-    process.dag.exists[:] = False
-    process.dag.strong[:] = False
-    process.dag.weak.clear()
-    process.dag.max_round = 0
+    process.dag.reset()
     for v in sorted(admitted, key=lambda v: (v.round, v.source)):
         process.dag.insert(v)
         if v.round >= 1:
