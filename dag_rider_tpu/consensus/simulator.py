@@ -91,7 +91,8 @@ class Simulation:
         # fixed per-dispatch costs per cycle, identical accept bits.
         shared = self.processes[0].verifier if self.processes else None
         coalesce = (
-            len(self.processes) > 1
+            shared is not None
+            and len(self.processes) > 1
             and all(p.verifier is shared for p in self.processes)
         )
         for p in self.processes:

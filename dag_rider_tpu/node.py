@@ -167,6 +167,7 @@ class Node:
         self._thread: Optional[threading.Thread] = None
         self._submit_lock = threading.Lock()
         self._submit_queue: list = []
+        self._stopped = False
 
         if self.ckpt_dir and checkpoint.latest_round(self.ckpt_dir) is not None:
             checkpoint.restore(self.process, self.ckpt_dir)
@@ -177,8 +178,14 @@ class Node:
         block lands in a handoff queue the pump thread drains — Process
         state is only ever touched from the pump thread (a caller-thread
         process.submit racing the pump's step() corrupted state rarely
-        enough to be a flaky-suite heisenbug)."""
+        enough to be a flaky-suite heisenbug). After stop() the queue is
+        never drained again, so a late submit raises instead of silently
+        swallowing the block (ADVICE r3)."""
         with self._submit_lock:
+            if self._stopped:
+                raise RuntimeError(
+                    f"node {self.process.index} is stopped; block not accepted"
+                )
             self._submit_queue.append(block)
 
     def start(self) -> None:
@@ -188,6 +195,10 @@ class Node:
         self._thread.start()
 
     def stop(self) -> None:
+        # Refuse new submissions first: anything enqueued after the final
+        # _drain_submissions below would never be drained again.
+        with self._submit_lock:
+            self._stopped = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)

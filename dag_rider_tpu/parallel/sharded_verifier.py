@@ -17,6 +17,7 @@ runs agree bit-for-bit (test_parallel.py asserts this on the virtual
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -51,6 +52,12 @@ class ShardedTPUVerifier(TPUVerifier):
         # Replicating the 8-bit tables (1.07 GB at n=256) on every chip
         # is the wrong trade for a mesh; the sharded comb program is
         # pinned to 4-bit windows.
+        if self._comb_bits != 4:
+            warnings.warn(
+                f"ShardedTPUVerifier pins comb windows to 4 bits; ignoring "
+                f"DAGRIDER_COMB_BITS={self._comb_bits}",
+                stacklevel=2,
+            )
         self._comb_bits = 4
         self.mesh = mesh if mesh is not None else make_mesh()
         self._n_shards = int(np.prod(self.mesh.devices.shape))

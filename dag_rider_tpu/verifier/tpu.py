@@ -216,15 +216,18 @@ def _b_table_cached() -> np.ndarray:
     return _B_TABLE_CACHED
 
 
-_B_TABLE8_DEV = None
+# Keyed by the default backend's platform name: ~34 MB of device memory
+# per entry, so a backend switch (cpu tests after a tpu run, or vice
+# versa) must not serve arrays resident on the wrong device (ADVICE r3).
+_B_TABLE8_DEV: dict = {}
 
 
 def _b_table8_dev():
     """8-bit base-point table (registry-independent, device-resident) —
-    built once per process through the same device builder on a one-key
-    "registry" holding B itself."""
-    global _B_TABLE8_DEV
-    if _B_TABLE8_DEV is None:
+    built once per process *per backend* through the same device builder
+    on a one-key "registry" holding B itself."""
+    backend = jax.default_backend()
+    if backend not in _B_TABLE8_DEV:
         from dag_rider_tpu.crypto import ed25519
         from dag_rider_tpu.ops import comb, field
 
@@ -234,8 +237,8 @@ def _b_table8_dev():
             jnp.asarray(field.to_limbs(by)[None]),
             jnp.asarray(field.to_limbs(bt)[None]),
         )[0]
-        _B_TABLE8_DEV = jax.jit(comb.pad_rows)(built)
-    return _B_TABLE8_DEV
+        _B_TABLE8_DEV[backend] = jax.jit(comb.pad_rows)(built)
+    return _B_TABLE8_DEV[backend]
 
 
 def _comb_impl(size: int) -> str:
