@@ -391,9 +391,14 @@ def _measure() -> None:
         t0 = time.monotonic()
         pumped = 0
         while time.monotonic() - t0 < sim_budget:
-            # small chunks: the box is only checked between chunks, so a
-            # chunk must stay well under the budget even on a slow backend
-            pumped += sim.run(max_messages=500)
+            # Round-sized chunks: one full round of burst traffic at n=64
+            # is 64*63 = 4032 deliveries, so each chunk coalesces into ONE
+            # fixed-bucket device dispatch (round-3 ran 500-message chunks
+            # — 1/8 of a round padded to the same 4096 bucket, paying the
+            # fixed dispatch cost 8x per round). Must not exceed the 4096
+            # bucket, or the simulator falls back to the chunked
+            # synchronous path. A chunk stays well under the budget box.
+            pumped += sim.run(max_messages=4032)
         dt = time.monotonic() - t0
         sigs = sum(
             sum(p.metrics.verify_batch_sizes) for p in sim.processes

@@ -43,6 +43,10 @@ class DagState:
         #: round_size / vertices_in_round without dense-row scans)
         self._round_vertices: Dict[int, Dict[int, Vertex]] = {}
         self.max_round = 0
+        #: lowest round inserted since the owner last consumed this marker
+        #: (consumer: Process._weak_edges_for's truncated sweep — 0 means
+        #: "sweep everything", the cold-start/restore-safe default).
+        self.insert_min_round = 0
 
     def reset(self) -> None:
         """Empty every mirror (used by checkpoint restore before
@@ -54,6 +58,7 @@ class DagState:
         self.strong[:] = False
         self.weak.clear()
         self.max_round = 0
+        self.insert_min_round = 0
 
     # -- growth ------------------------------------------------------------
 
@@ -78,28 +83,34 @@ class DagState:
         Admission policy (who may call this, and when) lives in the Process;
         this container only maintains the mirrors.
         """
-        self._ensure_capacity(v.round)
-        if self.exists[v.round, v.source]:
-            raise ValueError(f"vertex {v.id} already present")
-        self.vertices[v.id] = v
-        self._round_vertices.setdefault(v.round, {})[v.source] = v
-        self.exists[v.round, v.source] = True
-        prev_round = v.round - 1
-        for e in v.strong_edges:
-            if e.round != prev_round:
-                raise ValueError(
-                    f"strong edge {e} from {v.id} must target round {prev_round}"
-                )
-        # one fancy-index write instead of ~2f+1 numpy scalar stores
-        self.strong[v.round, v.source, [e.source for e in v.strong_edges]] = (
-            True
-        )
-        if v.weak_edges:
-            self.weak[(v.round, v.source)] = tuple(
-                (e.round, e.source) for e in v.weak_edges
+        vid = v.id
+        r, s = vid.round, vid.source
+        self._ensure_capacity(r)
+        if vid in self.vertices:
+            raise ValueError(f"vertex {vid} already present")
+        sr, ss, wr, ws = v.edge_arrays()
+        # The admission gate (Process.on_message) already proved the edge
+        # rounds for vertices that passed it — its memo on the vertex
+        # skips the redundant re-scan on this hot path.
+        g = v.__dict__.get("_gate")
+        if (g is None or g[1]) and sr.size and (sr != r - 1).any():
+            raise ValueError(
+                f"strong edges from {vid} must target round {r - 1}"
             )
-        if v.round > self.max_round:
-            self.max_round = v.round
+        self.vertices[vid] = v
+        rv = self._round_vertices.get(r)
+        if rv is None:
+            rv = self._round_vertices[r] = {}
+        rv[s] = v
+        self.exists[r, s] = True
+        # one fancy-index write instead of ~2f+1 numpy scalar stores
+        self.strong[r, s, ss] = True
+        if wr.size:
+            self.weak[(r, s)] = tuple(zip(wr.tolist(), ws.tolist()))
+        if r > self.max_round:
+            self.max_round = r
+        if r < self.insert_min_round:
+            self.insert_min_round = r
 
     # -- queries -----------------------------------------------------------
 
@@ -157,6 +168,35 @@ class DagState:
                 for i in np.flatnonzero(row):
                     for (r2, j) in self.weak.get((r, i), ()):
                         reached[r2, j] = True
+        return reached
+
+    def closure_stopped(
+        self, seed: VertexID, stop_mask: np.ndarray
+    ) -> np.ndarray:
+        """Causal history of ``seed``, pruning propagation at vertices
+        where ``stop_mask`` is True.
+
+        Sound ONLY for a causally-closed stop set (callers pass the
+        delivered bitmap, and delivery is whole-history-at-a-time):
+        anything reachable solely through a stopped vertex is itself in
+        the stop set, so pruning there loses no *unstopped* vertex.
+        Steady-state wave commits touch only the few undelivered rounds
+        at the top instead of rescanning the full DAG depth, and the
+        early-exit fires once no unstopped vertex remains at or below
+        the sweep round.
+        """
+        R = seed.round + 1
+        reached = np.zeros((R, self.n), dtype=bool)
+        reached[seed.round, seed.source] = True
+        for r in range(seed.round, 0, -1):
+            act = reached[r] & ~stop_mask[r]
+            if act.any():
+                reached[r - 1] |= act @ self.strong[r]
+                for i in np.flatnonzero(act):
+                    for (r2, j) in self.weak.get((r, i), ()):
+                        reached[r2, j] = True
+            elif not (reached[:r] & ~stop_mask[:r]).any():
+                break
         return reached
 
     def path(

@@ -103,6 +103,13 @@ class Process:
         self._pending_waves: Set[int] = set()
         self.delivered: Set[VertexID] = set()
         self.delivered_log: List[VertexID] = []
+        #: dense bool[capacity, n] twin of ``delivered`` — lets the
+        #: ordering pass diff a closure bitmap against delivered state in
+        #: one vectorized op instead of per-slot set probes (the
+        #: per-commit rescan of the whole history was ~25% of the 64-node
+        #: host profile). Written only by _order_vertices; checkpoint
+        #: restore re-derives it via _rebuild_delivered_mask.
+        self._delivered_mask = np.zeros_like(self.dag.exists)
         self._stuck_steps = 0
         self._sync_last_request = float("-inf")
         self._sync_last_serve: Dict[int, float] = {}  # requester -> mono
@@ -115,6 +122,17 @@ class Process:
         # once, so ``_drain_verify`` sees round-sized batches instead of
         # one dispatch per message (round-1 VERDICT weak #2).
         self.defer_steps = False
+        # Deferred a_deliver (pipeline overlap): when True, _try_wave
+        # commits waves immediately (decided_wave advances, protocol
+        # progress is unaffected) but queues the ordering/delivery walk
+        # for :meth:`flush_deliveries` — the only host work with no
+        # causal dependency on an in-flight verify dispatch, so a driver
+        # can run it while the device crunches the next batch. Safe to
+        # defer: an admitted leader's entire causal history is already
+        # present (buffer admission gate), so the closure is identical
+        # whenever it runs, and FIFO flushing preserves delivery order.
+        self.defer_delivery = False
+        self._deferred_orders: Deque = deque()
 
         transport.subscribe(index, self.on_message)
 
@@ -194,28 +212,7 @@ class Process:
             else:
                 self.metrics.inc("msgs_duplicate")
             return
-        # r_deliver admission gate: >= 2f+1 strong edges
-        # (process.go:164-168), all targeting round-1, all sources in-range.
-        # A Byzantine vertex must not be able to index outside [0, n)
-        # (negative sources would silently alias via numpy wraparound).
-        # (Plain loops with hoisted locals: this gate runs once per
-        # received vertex and the generator-expression version was a
-        # visible slice of the 64-node profile.)
-        vr = v.id.round
-        n_cfg = self.cfg.n
-        bad_edges = len(set(v.strong_edges)) < self.cfg.quorum
-        if not bad_edges:
-            prev_round = vr - 1
-            for e in v.strong_edges:
-                if e.round != prev_round or not 0 <= e.source < n_cfg:
-                    bad_edges = True
-                    break
-        if not bad_edges:
-            for e in v.weak_edges:
-                if not (1 <= e.round <= vr - 2) or not 0 <= e.source < n_cfg:
-                    bad_edges = True
-                    break
-        if bad_edges:
+        if not self.edges_valid(v):
             self.metrics.inc("msgs_rejected_edges")
             self.log.event(
                 "reject_edges",
@@ -233,6 +230,38 @@ class Process:
             self._admit_to_buffer(v)
         if self._started and not self.defer_steps:
             self.step()
+
+    def edges_valid(self, v: Vertex) -> bool:
+        """The r_deliver admission gate: >= 2f+1 distinct strong edges
+        (process.go:164-168), all targeting round-1, all sources in
+        [0, n) — a Byzantine vertex must not be able to index outside the
+        dense mirrors (negative sources would silently alias via numpy
+        wraparound), and every downstream fancy-index (dag.insert,
+        _drain_buffer) relies on this gate having run. Vectorized over
+        the memoized edge arrays and memoized on the vertex: the result
+        is a pure function of (vertex, n, quorum), so the n-1 sibling
+        processes of an in-process cluster reuse it instead of
+        re-scanning ~2f+1 edges each (round-4 host profile: this gate's
+        per-edge loops were ~15 us/message)."""
+        vr = v.id.round
+        gate_key = (self.cfg.n, self.cfg.quorum)
+        cached_gate = v.__dict__.get("_gate")
+        if cached_gate is not None and cached_gate[0] == gate_key:
+            return not cached_gate[1]
+        sr, ss, wr, ws = v.edge_arrays()
+        n_cfg = self.cfg.n
+        bad_edges = bool(
+            len(np.unique(ss)) < self.cfg.quorum
+            or (sr != vr - 1).any()
+            or (ss < 0).any()
+            or (ss >= n_cfg).any()
+            or (wr < 1).any()
+            or (wr > vr - 2).any()
+            or (ws < 0).any()
+            or (ws >= n_cfg).any()
+        )
+        object.__setattr__(v, "_gate", (gate_key, bad_edges))
+        return not bad_edges
 
     def _admit_to_buffer(self, v: Vertex) -> None:
         self.buffer.append(v)
@@ -323,6 +352,7 @@ class Process:
         blocked = self._blocked_on
         while changed:
             changed = False
+            exists = self.dag.exists  # re-fetch: capacity growth reallocates
             keep: List[Vertex] = []
             for v in self.buffer:
                 if v.id.round > self.round:
@@ -339,18 +369,23 @@ class Process:
                 if bp is not None and not present(bp):
                     keep.append(v)
                     continue
-                preds_present = True
-                for e in v.strong_edges:
-                    if not present(e):
-                        preds_present = False
-                        blocked[v.id] = e
-                        break
-                if preds_present:
-                    for e in v.weak_edges:
-                        if not present(e):
-                            preds_present = False
-                            blocked[v.id] = e
-                            break
+                # Vectorized predecessor check against the dense mirror
+                # (edge rounds/sources are gate-validated in [0, n) and
+                # below v.round <= self.round < capacity, so the fancy
+                # index cannot alias): two indexed reads replace ~2f+1
+                # dict probes — the hottest slice of the 64-node profile.
+                sr, ss, wr, ws = v.edge_arrays()
+                s_hit = exists[v.id.round - 1, ss]
+                preds_present = bool(s_hit.all())
+                if not preds_present:
+                    k = int(np.argmin(s_hit))
+                    blocked[v.id] = VertexID(v.id.round - 1, int(ss[k]))
+                elif wr.size:
+                    w_hit = exists[wr, ws]
+                    preds_present = bool(w_hit.all())
+                    if not preds_present:
+                        k = int(np.argmin(w_hit))
+                        blocked[v.id] = VertexID(int(wr[k]), int(ws[k]))
                 if preds_present:
                     blocked.pop(v.id, None)
                     self.dag.insert(v)
@@ -425,6 +460,13 @@ class Process:
         )
         if self.signer is not None:
             v = self.signer.sign_vertex(v)
+        # Own proposals satisfy the admission gate by construction
+        # (strong = the full quorum-checked frontier, weak from the
+        # sweep); pre-stamping the gate memo keeps dag.insert and sibling
+        # processes off the re-validation path.
+        object.__setattr__(
+            v, "_gate", ((self.cfg.n, self.cfg.quorum), False)
+        )
         return v
 
     def _weak_edges_for(
@@ -438,32 +480,47 @@ class Process:
             return ()
         dag = self.dag
         n = self.cfg.n
-        # Single backward sweep, O(R*n^2) total (round-2 VERDICT weak #5:
-        # the previous version recomputed a full closure per straggler).
-        # Invariant: when the sweep reaches round r, reached[r] is the set
-        # of round-r vertices in the causal history of v via all higher
-        # rounds — valid because after processing a round every existing
-        # vertex there is *covered* (reachable or freshly weak-linked), so
-        # covered vertices' out-edges are exactly what must propagate.
-        # Order within a round is irrelevant (edges only cross rounds).
-        reached = np.zeros((rnd, n), dtype=bool)
+        # Backward sweep (round-2 VERDICT weak #5: the closure-per-
+        # straggler version). Invariant: when the sweep reaches round r,
+        # reached[r] is the set of round-r vertices in the causal history
+        # of v via all higher rounds — valid because after processing a
+        # round every existing vertex there is *covered* (reachable or
+        # freshly weak-linked), so covered vertices' out-edges are exactly
+        # what must propagate. Order within a round is irrelevant (edges
+        # only cross rounds).
+        #
+        # Truncation (round 4): every vertex of round <= rnd-2 already
+        # present at our previous proposal is in that proposal's causal
+        # history (its strong edges took ALL of round rnd-2, and its sweep
+        # weak-linked everything unreachable below), and our previous
+        # vertex is itself a strong-edge target of this proposal — so only
+        # rounds >= dag.insert_min_round (the lowest round inserted since
+        # that sweep) can hold uncovered candidates. Paths are monotone in
+        # round, so stopping the propagation at lo loses nothing above it.
+        # Steady state sweeps O(1) rounds instead of O(R); cold start and
+        # checkpoint restore reset the marker to 0 (full sweep).
+        lo = max(1, min(dag.insert_min_round, rnd - 1))
+        dag.insert_min_round = rnd
+        base = lo - 1  # lowest row the sweep can write (r == lo writes lo-1)
+        reached = np.zeros((rnd - base, n), dtype=bool)  # rows base..rnd-1
         covered = np.zeros(n, dtype=bool)
         for e in strong:  # frontier round rnd-1: covered = strong targets
             covered[e.source] = True
         weak: List[VertexID] = []
-        for r in range(rnd - 1, 0, -1):
+        for r in range(rnd - 1, lo - 1, -1):
             if r <= rnd - 2:
-                covered = reached[r].copy()
+                covered = reached[r - base].copy()
                 for u in dag.vertices_in_round(r):
                     if not covered[u.source]:
                         weak.append(u.id)
                         covered[u.source] = True
             if r == 1:
                 break  # round 0 is genesis; nothing below to propagate to
-            reached[r - 1] |= covered @ dag.strong[r]
+            reached[r - 1 - base] |= covered @ dag.strong[r]
             for i in np.flatnonzero(covered):
                 for (r2, j) in dag.weak.get((r, i), ()):
-                    reached[r2, j] = True
+                    if r2 >= lo:  # below lo is never read
+                        reached[r2 - base, j] = True
         return tuple(weak)
 
     # ------------------------------------------------------------------
@@ -616,28 +673,43 @@ class Process:
         # Retroactive leader chain (process.go:341-350): walk back through
         # undecided waves, committing every prior leader the current one
         # covers by a strong path.
-        with Timer() as t:
-            leaders: Stack[Vertex] = Stack()
-            leaders.push(leader)
-            cur = leader
-            for w in range(wave - 1, self.decided_wave, -1):
-                prior = self._wave_leader(w)
-                if prior is not None and self.dag.path(
-                    cur.id, prior.id, strong_only=True
-                ):
-                    leaders.push(prior)
-                    cur = prior
-            self.decided_wave = wave
-            self.metrics.inc("waves_decided")
-            self.log.event(
-                "wave_decided",
-                wave=wave,
-                leader=leader.source,
-                votes=votes,
-                chain=len(leaders),
+        t0 = _time.perf_counter()
+        leaders: Stack[Vertex] = Stack()
+        leaders.push(leader)
+        cur = leader
+        for w in range(wave - 1, self.decided_wave, -1):
+            prior = self._wave_leader(w)
+            if prior is not None and self.dag.path(
+                cur.id, prior.id, strong_only=True
+            ):
+                leaders.push(prior)
+                cur = prior
+        self.decided_wave = wave
+        self.metrics.inc("waves_decided")
+        self.log.event(
+            "wave_decided",
+            wave=wave,
+            leader=leader.source,
+            votes=votes,
+            chain=len(leaders),
+        )
+        if self.defer_delivery:
+            self._deferred_orders.append(
+                (leaders, _time.perf_counter() - t0)
             )
-            self._order_vertices(leaders)
-        self.metrics.observe_wave_commit(t.seconds)
+            return
+        self._order_vertices(leaders)
+        self.metrics.observe_wave_commit(_time.perf_counter() - t0)
+
+    def flush_deliveries(self) -> None:
+        """Run queued ordering/delivery walks (see ``defer_delivery``).
+        The wave-commit metric observes chain-walk + ordering as one
+        sample, same as the inline path."""
+        while self._deferred_orders:
+            leaders, partial = self._deferred_orders.popleft()
+            with Timer() as t:
+                self._order_vertices(leaders)
+            self.metrics.observe_wave_commit(partial + t.seconds)
 
     def _wave_leader(self, wave: int) -> Optional[Vertex]:
         """Leader lookup (reference ``getWaveVertexLeader``,
@@ -651,7 +723,7 @@ class Process:
         matmul chain — host twin of ops.dag_kernels.wave_commit_votes."""
         reach = np.eye(self.cfg.n, dtype=bool)
         for r in range(r_hi, r_lo, -1):
-            reach = (reach.astype(np.int32) @ self.dag.strong[r].astype(np.int32)) > 0
+            reach = reach @ self.dag.strong[r]
         votes = reach[:, leader_src] & self.dag.exists[r_hi]
         return int(votes.sum())
 
@@ -665,21 +737,40 @@ class Process:
         runs, it calls the client callback, and delivered vertices are
         skipped exactly once)."""
         n_before = len(self.delivered_log)
+        dmask = self._delivered_mask
+        if dmask.shape[0] < self.dag.exists.shape[0]:
+            grown = np.zeros_like(self.dag.exists)
+            grown[: dmask.shape[0]] = dmask
+            self._delivered_mask = dmask = grown
         while not leaders.is_empty():
             leader = leaders.pop()
-            reached = self.dag.closure([leader.id], strong_only=False)
-            for r in range(1, leader.round + 1):
-                for src in np.flatnonzero(reached[r]):
-                    vid = VertexID(r, int(src))
-                    if vid in self.delivered:
-                        continue
-                    self.delivered.add(vid)
-                    self.delivered_log.append(vid)
-                    self.metrics.inc("vertices_delivered")
-                    if self.on_deliver is not None:
-                        self.on_deliver(self.dag.vertices[vid])
+            # Delivered-pruned closure: identical fresh set as the full
+            # closure (delivery is causally closed), but the sweep stops
+            # at the already-delivered frontier instead of descending the
+            # whole DAG depth on every commit.
+            reached = self.dag.closure_stopped(leader.id, dmask)
+            # One vectorized diff against delivered state, then touch only
+            # the genuinely-new slots. argwhere's row-major order IS the
+            # delivery order (ascending round, then source).
+            hi = leader.round + 1
+            fresh = reached[1:hi] & ~dmask[1:hi]
+            for rr, src in np.argwhere(fresh):
+                vid = VertexID(int(rr) + 1, int(src))
+                dmask[vid.round, vid.source] = True
+                self.delivered.add(vid)
+                self.delivered_log.append(vid)
+                self.metrics.inc("vertices_delivered")
+                if self.on_deliver is not None:
+                    self.on_deliver(self.dag.vertices[vid])
         self.log.event(
             "delivered",
             count=len(self.delivered_log) - n_before,
             total=len(self.delivered_log),
         )
+
+    def _rebuild_delivered_mask(self) -> None:
+        """Re-derive the dense delivered bitmap from ``delivered_log`` —
+        for callers (checkpoint restore) that replace the log wholesale."""
+        self._delivered_mask = np.zeros_like(self.dag.exists)
+        for vid in self.delivered_log:
+            self._delivered_mask[vid.round, vid.source] = True

@@ -10,6 +10,7 @@ interleave deliveries to explore asynchrony.
 from __future__ import annotations
 
 import random
+import time
 from typing import Callable, List, Optional
 
 from dag_rider_tpu.config import Config
@@ -95,8 +96,19 @@ class Simulation:
             and len(self.processes) > 1
             and all(p.verifier is shared for p in self.processes)
         )
+        # Pipelined dispatch (round-3 VERDICT #2): with an async-capable
+        # shared verifier, issue the merged dispatch without syncing, run
+        # every queued ordering/delivery walk while the device works (the
+        # one slice of host work with no causal dependency on the
+        # in-flight masks — everything else in the cycle is downstream of
+        # them), then resolve. Chunked fallback covers bursts larger than
+        # the verifier's fixed bucket.
+        dispatch = getattr(shared, "dispatch_batch", None)
+        resolve = getattr(shared, "resolve_batch", None)
+        pipelined = coalesce and dispatch is not None and resolve is not None
         for p in self.processes:
             p.defer_steps = True
+            p.defer_delivery = pipelined
         try:
             for p in self.processes:
                 p.start()
@@ -106,18 +118,47 @@ class Simulation:
                 if coalesce:
                     batches = [p.take_verify_batch() for p in self.processes]
                     if any(batches):
-                        with Timer() as t:
-                            masks = shared.verify_rounds(batches)
+                        flat = [v for b in batches for v in b]
+                        bucket = getattr(shared, "fixed_bucket", None)
+                        if pipelined and (
+                            bucket is None or len(flat) <= bucket
+                        ):
+                            t0 = time.perf_counter()
+                            pending = dispatch(flat)
+                            tf0 = time.perf_counter()
+                            for p in self.processes:
+                                p.flush_deliveries()
+                            tf1 = time.perf_counter()
+                            mask = resolve(pending)
+                            # verify wall time excludes the overlapped
+                            # delivery flush (flush_deliveries already
+                            # observes it into the wave-commit metric —
+                            # charging it here too would double-count)
+                            verify_s = (time.perf_counter() - t0) - (
+                                tf1 - tf0
+                            )
+                        else:
+                            with Timer() as t:
+                                mask = shared.verify_rounds(
+                                    batches
+                                )  # chunked, synchronous
+                            mask = [m for ms in mask for m in ms]
+                            verify_s = t.seconds
                         # Attribute the merged dispatch time size-
                         # proportionally and skip empty batches — charging
                         # every process the full wall time would corrupt
                         # per-process sigs_per_sec / p50 metrics.
-                        total = sum(len(b) for b in batches)
-                        for p, b, m in zip(self.processes, batches, masks):
+                        total = len(flat)
+                        pos = 0
+                        for p, b in zip(self.processes, batches):
                             if b:
                                 p.apply_verify_mask(
-                                    b, m, t.seconds * len(b) / total
+                                    b,
+                                    mask[pos : pos + len(b)],
+                                    verify_s * len(b) / total,
                                 )
+                                pos += len(b)
+                            # empty batches advance nothing
                 for p in self.processes:
                     p.step()
                 if got == 0 or delivered + got >= max_messages:
@@ -127,6 +168,9 @@ class Simulation:
         finally:
             for p in self.processes:
                 p.defer_steps = False
+                if pipelined:
+                    p.flush_deliveries()
+                    p.defer_delivery = False
         return delivered
 
     # -- assertions for tests ---------------------------------------------

@@ -31,6 +31,7 @@ class InMemoryTransport(Transport):
         self._lock = threading.Lock()
         self._handlers: Dict[int, Handler] = {}
         self._queue: Deque[Tuple[int, BroadcastMessage]] = deque()
+        self._fanout: list[int] = []  # sorted handler ids, cached
         self.delivered_count = 0
 
     def subscribe(self, index: int, handler: Handler) -> None:
@@ -38,12 +39,14 @@ class InMemoryTransport(Transport):
             if index in self._handlers:
                 raise ValueError(f"process {index} already subscribed")
             self._handlers[index] = handler
+            self._fanout = sorted(self._handlers)
 
     def broadcast(self, msg: BroadcastMessage) -> None:
         with self._lock:
-            for dest in sorted(self._handlers):
-                if dest != msg.sender:
-                    self._queue.append((dest, msg))
+            sender = msg.sender
+            self._queue.extend(
+                (dest, msg) for dest in self._fanout if dest != sender
+            )
 
     # -- composition hooks (used by FaultyTransport / schedulers) ----------
 

@@ -97,14 +97,28 @@ def restore(process, path: str) -> None:
         v, _ = codec.decode_vertex(data[offset : offset + ln])
         offset += ln
         (buffered if tag & 0x80000000 else admitted).append(v)
-    # Rebuild the DAG in round order so insert()'s invariants hold.
+    # Rebuild the DAG in round order so insert()'s invariants hold. The
+    # admission gate re-runs for every round>=1 vertex: the hot paths
+    # (dense-mirror fancy indexing in dag.insert / _drain_buffer) rely on
+    # gate-validated edge bounds, and a corrupted or crafted checkpoint
+    # must fail safe (vertex dropped) rather than alias numpy indices.
     process.dag.reset()
     for v in sorted(admitted, key=lambda v: (v.round, v.source)):
+        if v.round >= 1 and not process.edges_valid(v):
+            process.log.event(
+                "restore_drop_invalid", round=v.round, source=v.source
+            )
+            continue
         process.dag.insert(v)
         if v.round >= 1:
             process._seen_digests[v.id] = v.digest()
             process._observe_coin_share(v)
     for v in buffered:
+        if not process.edges_valid(v):
+            process.log.event(
+                "restore_drop_invalid", round=v.round, source=v.source
+            )
+            continue
         process._admit_to_buffer(v)
         process._seen_digests[v.id] = v.digest()
     process.round = manifest["round"]
@@ -129,6 +143,7 @@ def restore(process, path: str) -> None:
         VertexID(r, s) for r, s in manifest["delivered_log"]
     ]
     process.delivered = set(process.delivered_log)
+    process._rebuild_delivered_mask()
     process.blocks_to_propose.clear()
     for txs in manifest["blocks_to_propose"]:
         process.blocks_to_propose.append(

@@ -282,6 +282,47 @@ def test_weak_edges_single_sweep_matches_oracle():
         assert got == want, f"seed={seed}: {got} != {want}"
 
 
+def test_weak_edges_truncated_sweep_matches_oracle():
+    """The production shape the round-4 truncation optimizes: consecutive
+    proposals with stragglers arriving rounds late. The marker-truncated
+    sweep (dag.insert_min_round) must match the from-scratch oracle at
+    EVERY proposal — not just the cold first one."""
+    import numpy as np
+
+    rng = np.random.default_rng(9)
+    cfg = Config(n=7)  # quorum = 5
+    p = Process(cfg, 0, InMemoryTransport())
+    late = {}  # release_round -> [vertex]
+    weak_total = 0
+    for rnd in range(1, 14):
+        for v in late.pop(rnd, []):
+            p.dag.insert(v)
+        # Propose at rnd exactly as _create_vertex does: strong-link the
+        # whole present frontier, compute weak edges via the (truncated)
+        # sweep, check against the oracle, then insert with those edges.
+        prev = [u.id for u in p.dag.vertices_in_round(rnd - 1)]
+        strong = tuple(prev)
+        got = p._weak_edges_for(rnd, strong)
+        want = _brute_weak_edges(p, rnd, strong)
+        assert got == want, f"rnd={rnd}: {got} != {want}"
+        weak_total += len(got)
+        p.dag.insert(
+            Vertex(id=VertexID(rnd, 0), strong_edges=strong, weak_edges=got)
+        )
+        # Peers for round rnd: 4 on time (5 with ours = quorum), 2 late.
+        for s in range(1, cfg.n):
+            targets = rng.permutation(len(prev))[: cfg.quorum]
+            v = Vertex(
+                id=VertexID(rnd, s),
+                strong_edges=tuple(prev[t] for t in targets),
+            )
+            if s <= 4:
+                p.dag.insert(v)
+            else:
+                late.setdefault(rnd + int(rng.integers(2, 4)), []).append(v)
+    assert weak_total > 0  # the scenario actually produced stragglers
+
+
 def test_weak_edges_partial_frontier_matches_oracle():
     """With a sub-quorum strong frontier the sweep must not treat
     unlinked round-(rnd-1) vertices as covered."""
