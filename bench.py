@@ -381,7 +381,9 @@ def _measure() -> None:
         warm_all = _signed_round(signers, n, 1, quorum)
         shared.verify_batch(warm_all[:9])  # one compile at the fixed bucket
         _mark("ladder sim64: fixed-bucket program pre-warmed")
-        cfg = Config(n=n, coin="round_robin", propose_empty=True)
+        # gc_depth bounds the live DAG window (BASELINE config #3 wants a
+        # 10k-vertex run — cumulative, over bounded state)
+        cfg = Config(n=n, coin="round_robin", propose_empty=True, gc_depth=24)
         sim = Simulation(
             cfg,
             verifier_factory=lambda i: shared,
@@ -418,6 +420,13 @@ def _measure() -> None:
             "sigs_per_sec": round(sigs / dt, 1),
             "vertices_delivered_total": delivered,
             "max_round": max(p.round for p in sim.processes),
+            # bounded-memory evidence: cumulative DAG size vs live window
+            "vertices_live_max": max(
+                len(p.dag.vertices) for p in sim.processes
+            ),
+            "vertices_pruned_total": sum(
+                p.dag.pruned_count for p in sim.processes
+            ),
             "wave_commit_p50_ms": (
                 round(1e3 * waves[len(waves) // 2], 2) if waves else None
             ),

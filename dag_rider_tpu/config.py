@@ -73,6 +73,16 @@ class Config:
     # still extracts at most one window per cooldown.
     sync_request_cooldown_s: float = 0.5
     sync_serve_cooldown_s: float = 0.2
+    # Garbage-collection depth in rounds (None = unbounded, matching the
+    # reference's grow-forever state, process.go:72-85). When set, the
+    # ordering rule deterministically EXCLUDES vertices with
+    # round <= leader_round - gc_depth from delivery (every process
+    # excludes the same vertices for the same committed leader chain, so
+    # the total order stays identical — the standard DAG-BFT GC trade:
+    # fairness holds only for vertices admitted within the window), and
+    # each process retires DAG state below its decided frontier minus
+    # gc_depth (DagState.prune_below), bounding memory for long runs.
+    gc_depth: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -91,6 +101,16 @@ class Config:
             raise ValueError(f"unknown verifier backend {self.verifier_backend!r}")
         if self.coin not in ("fixed", "round_robin", "threshold_bls"):
             raise ValueError(f"unknown coin {self.coin!r}")
+        if self.gc_depth is not None:
+            # The horizon must sit safely below everything the live
+            # machinery touches: catch-up sync windows, the current
+            # wave's 4 rounds, and one wave of retroactive leader walk.
+            floor = self.sync_window + 2 * self.wave_length
+            if self.gc_depth < floor:
+                raise ValueError(
+                    f"gc_depth must be >= sync_window + 2*wave_length "
+                    f"({floor}), got {self.gc_depth}"
+                )
 
     @property
     def quorum(self) -> int:

@@ -15,6 +15,17 @@ Weak edges are kept sparse host-side (they are rare and round-skipping);
 ordering/reachability queries use vectorized frontier propagation over the
 dense mirrors + sparse weak lists — O(rounds * n) bitmap work per query
 instead of the reference's per-edge full-DAG scans.
+
+Memory bounding (round-4): the reference grows its DAG forever
+(``process.go:72-85``) and so did rounds 1-3 here. :meth:`prune_below`
+retires everything under a caller-chosen floor — dense rows shift down so
+row index = ``round - base_round``, vertices/weak entries are dropped, and
+the window's capacity is reused instead of doubling forever. All public
+methods keep speaking ABSOLUTE round numbers; with ``base_round == 0``
+(pruning disabled, the default) every code path is bit-identical to the
+unbounded behavior. The *policy* for choosing the floor (the deterministic
+GC/ordering-exclusion rule that makes pruning safe across processes) lives
+in the Process.
 """
 
 from __future__ import annotations
@@ -34,9 +45,11 @@ class DagState:
         self.cfg = cfg
         self.n = cfg.n
         self._capacity = max(cfg.max_rounds, 8)
+        #: absolute round of dense row 0; rounds below are retired.
+        self.base_round = 0
         self.exists = np.zeros((self._capacity, self.n), dtype=bool)
         self.strong = np.zeros((self._capacity, self.n, self.n), dtype=bool)
-        # weak[(r, i)] -> tuple of (r2, j) targets, r2 < r-1.
+        # weak[(r, i)] -> tuple of (r2, j) targets, r2 < r-1 (absolute).
         self.weak: Dict[Tuple[int, int], Tuple[Tuple[int, int], ...]] = {}
         self.vertices: Dict[VertexID, Vertex] = {}
         #: per-round {source: Vertex} mirror of `vertices` (fast
@@ -47,6 +60,8 @@ class DagState:
         #: (consumer: Process._weak_edges_for's truncated sweep — 0 means
         #: "sweep everything", the cold-start/restore-safe default).
         self.insert_min_round = 0
+        #: vertices dropped by prune_below (metrics/tests)
+        self.pruned_count = 0
 
     def reset(self) -> None:
         """Empty every mirror (used by checkpoint restore before
@@ -57,16 +72,19 @@ class DagState:
         self.exists[:] = False
         self.strong[:] = False
         self.weak.clear()
+        self.base_round = 0
         self.max_round = 0
         self.insert_min_round = 0
+        self.pruned_count = 0
 
-    # -- growth ------------------------------------------------------------
+    # -- growth / retirement ----------------------------------------------
 
     def _ensure_capacity(self, rnd: int) -> None:
-        if rnd < self._capacity:
+        row = rnd - self.base_round
+        if row < self._capacity:
             return
         new_cap = self._capacity
-        while new_cap <= rnd:
+        while new_cap <= row:
             new_cap *= 2
         exists = np.zeros((new_cap, self.n), dtype=bool)
         strong = np.zeros((new_cap, self.n, self.n), dtype=bool)
@@ -74,6 +92,42 @@ class DagState:
         strong[: self._capacity] = self.strong
         self.exists, self.strong = exists, strong
         self._capacity = new_cap
+
+    def prune_below(self, floor: int) -> int:
+        """Retire every vertex with ``round < floor``; returns the count.
+
+        Dense rows shift down in place (capacity is *reused*, so a pruned
+        long-running DAG stops growing), vertex payloads and weak entries
+        below the floor are dropped, and ``base_round`` becomes ``floor``.
+        Callers own the safety argument — the Process only passes floors
+        under its deterministic ordering-exclusion horizon (cfg.gc_depth),
+        below which no delivery can ever happen at any correct process.
+        """
+        if floor <= self.base_round:
+            return 0
+        floor = min(floor, self.max_round + 1)
+        shift = floor - self.base_round
+        live = self._capacity - shift
+        if live > 0:
+            # .copy(): numpy overlapping slice assignment is not defined
+            self.exists[:live] = self.exists[shift:].copy()
+            self.strong[:live] = self.strong[shift:].copy()
+        self.exists[max(live, 0) :] = False
+        self.strong[max(live, 0) :] = False
+        removed = 0
+        for r in [r for r in self._round_vertices if r < floor]:
+            for v in self._round_vertices.pop(r).values():
+                del self.vertices[v.id]
+                removed += 1
+        for key in [k for k in self.weak if k[0] < floor]:
+            del self.weak[key]
+        self.base_round = floor
+        if self.max_round < floor:
+            self.max_round = floor
+        if self.insert_min_round < floor:
+            self.insert_min_round = floor
+        self.pruned_count += removed
+        return removed
 
     # -- mutation ----------------------------------------------------------
 
@@ -85,6 +139,8 @@ class DagState:
         """
         vid = v.id
         r, s = vid.round, vid.source
+        if r < self.base_round:
+            raise ValueError(f"vertex {vid} is below the pruned floor")
         self._ensure_capacity(r)
         if vid in self.vertices:
             raise ValueError(f"vertex {vid} already present")
@@ -102,9 +158,10 @@ class DagState:
         if rv is None:
             rv = self._round_vertices[r] = {}
         rv[s] = v
-        self.exists[r, s] = True
+        row = r - self.base_round
+        self.exists[row, s] = True
         # one fancy-index write instead of ~2f+1 numpy scalar stores
-        self.strong[r, s, ss] = True
+        self.strong[row, s, ss] = True
         if wr.size:
             self.weak[(r, s)] = tuple(zip(wr.tolist(), ws.tolist()))
         if r > self.max_round:
@@ -143,38 +200,44 @@ class DagState:
     def closure(
         self, seeds: Iterable[VertexID], strong_only: bool = False
     ) -> np.ndarray:
-        """Causal history of a seed set as a bool[R, n] bitmap.
+        """Causal history of a seed set as a bool bitmap whose row index
+        is ``round - base_round`` (absolute round with pruning off).
 
         Vectorized frontier propagation round-by-round (the host twin of
         :func:`dag_rider_tpu.ops.dag_kernels.closure_from`); weak edges are
         applied from the sparse map. Replaces the reference's per-target BFS
-        ``path`` (``process/process.go:89-148``).
+        ``path`` (``process/process.go:89-148``). Propagation stops at the
+        pruned floor: retired rounds report nothing.
         """
-        R = self.max_round + 1
+        base = self.base_round
+        R = self.max_round + 1 - base
         reached = np.zeros((R, self.n), dtype=bool)
         top = -1
         for s in seeds:
             if not self.present(s):
                 raise KeyError(f"seed {s} not in DAG")
-            reached[s.round, s.source] = True
+            reached[s.round - base, s.source] = True
             top = max(top, s.round)
-        for r in range(top, 0, -1):
-            row = reached[r]
+        for r in range(top, max(base, 0), -1):
+            row = reached[r - base]
             if not row.any():
                 continue
             # strong: one vector-matrix product per round.
-            reached[r - 1] |= row @ self.strong[r]
+            reached[r - base - 1] |= row @ self.strong[r - base]
             if not strong_only:
                 for i in np.flatnonzero(row):
                     for (r2, j) in self.weak.get((r, i), ()):
-                        reached[r2, j] = True
+                        if r2 >= base:
+                            reached[r2 - base, j] = True
         return reached
 
     def closure_stopped(
         self, seed: VertexID, stop_mask: np.ndarray
     ) -> np.ndarray:
         """Causal history of ``seed``, pruning propagation at vertices
-        where ``stop_mask`` is True.
+        where ``stop_mask`` is True. Rows of both bitmaps are indexed by
+        ``round - base_round`` (the caller's delivered mask is kept
+        base-aligned by Process.maybe_prune).
 
         Sound ONLY for a causally-closed stop set (callers pass the
         delivered bitmap, and delivery is whole-history-at-a-time):
@@ -185,17 +248,20 @@ class DagState:
         early-exit fires once no unstopped vertex remains at or below
         the sweep round.
         """
-        R = seed.round + 1
+        base = self.base_round
+        R = seed.round + 1 - base
         reached = np.zeros((R, self.n), dtype=bool)
-        reached[seed.round, seed.source] = True
-        for r in range(seed.round, 0, -1):
-            act = reached[r] & ~stop_mask[r]
+        reached[seed.round - base, seed.source] = True
+        for r in range(seed.round, max(base, 0), -1):
+            row = r - base
+            act = reached[row] & ~stop_mask[row]
             if act.any():
-                reached[r - 1] |= act @ self.strong[r]
+                reached[row - 1] |= act @ self.strong[row]
                 for i in np.flatnonzero(act):
                     for (r2, j) in self.weak.get((r, i), ()):
-                        reached[r2, j] = True
-            elif not (reached[:r] & ~stop_mask[:r]).any():
+                        if r2 >= base:
+                            reached[r2 - base, j] = True
+            elif not (reached[:row] & ~stop_mask[:row]).any():
                 break
         return reached
 
@@ -215,7 +281,7 @@ class DagState:
         if to.round >= frm.round:
             return False
         reached = self.closure([frm], strong_only=strong_only)
-        return bool(reached[to.round, to.source])
+        return bool(reached[to.round - self.base_round, to.source])
 
     # -- dense views for device kernels ------------------------------------
 
@@ -224,10 +290,15 @@ class DagState:
         the input format of :func:`ops.dag_kernels.reach_chain`."""
         if not 0 <= lo < hi:
             raise ValueError(f"need 0 <= lo < hi, got lo={lo}, hi={hi}")
-        return self.strong[hi:lo:-1]
+        if lo < self.base_round:
+            raise ValueError(
+                f"rounds <= {self.base_round} are pruned; asked for lo={lo}"
+            )
+        base = self.base_round
+        return self.strong[hi - base : lo - base : -1]
 
     def dense_snapshot(self, rounds: Optional[int] = None):
-        """(exists, strong) trimmed to ``rounds`` rows — checkpoint payload
-        and device-dispatch input."""
-        R = (self.max_round + 1) if rounds is None else rounds
+        """(exists, strong) trimmed to ``rounds`` rows (rows start at
+        ``base_round``) — checkpoint payload and device-dispatch input."""
+        R = (self.max_round + 1 - self.base_round) if rounds is None else rounds
         return self.exists[:R].copy(), self.strong[:R].copy()

@@ -103,6 +103,9 @@ class Process:
         self._pending_waves: Set[int] = set()
         self.delivered: Set[VertexID] = set()
         self.delivered_log: List[VertexID] = []
+        #: deliveries dropped from delivered_log by GC pruning (the log
+        #: keeps only the live window when cfg.gc_depth is set)
+        self.delivered_trimmed = 0
         #: dense bool[capacity, n] twin of ``delivered`` — lets the
         #: ordering pass diff a closure bitmap against delivered state in
         #: one vectorized op instead of per-slot set probes (the
@@ -353,10 +356,20 @@ class Process:
         while changed:
             changed = False
             exists = self.dag.exists  # re-fetch: capacity growth reallocates
+            base = self.dag.base_round
             keep: List[Vertex] = []
             for v in self.buffer:
                 if v.id.round > self.round:
                     keep.append(v)
+                    continue
+                if v.id.round <= base:
+                    # Below the pruned floor: its predecessors are retired
+                    # and the GC ordering rule excludes it from delivery
+                    # anywhere — unadmittable, drop it.
+                    self._buffered_ids.discard(v.id)
+                    blocked.pop(v.id, None)
+                    self.metrics.inc("msgs_below_gc_horizon")
+                    changed = True
                     continue
                 if present(v.id):
                     # raced in via another path; drop rather than re-insert
@@ -366,26 +379,43 @@ class Process:
                     changed = True
                     continue
                 bp = blocked.get(v.id)
-                if bp is not None and not present(bp):
+                if (
+                    bp is not None
+                    and bp.round > base
+                    and not present(bp)
+                ):
                     keep.append(v)
                     continue
+                # (a memoized blocker at/below the pruned floor falls
+                # through to full re-evaluation: the weak-target-below-
+                # base satisfaction rule below must get its chance, or a
+                # vertex blocked before a prune would wait forever on a
+                # round nobody can serve anymore)
                 # Vectorized predecessor check against the dense mirror
                 # (edge rounds/sources are gate-validated in [0, n) and
                 # below v.round <= self.round < capacity, so the fancy
                 # index cannot alias): two indexed reads replace ~2f+1
                 # dict probes — the hottest slice of the 64-node profile.
                 sr, ss, wr, ws = v.edge_arrays()
-                s_hit = exists[v.id.round - 1, ss]
+                s_hit = exists[v.id.round - 1 - base, ss]
                 preds_present = bool(s_hit.all())
                 if not preds_present:
                     k = int(np.argmin(s_hit))
                     blocked[v.id] = VertexID(v.id.round - 1, int(ss[k]))
                 elif wr.size:
-                    w_hit = exists[wr, ws]
-                    preds_present = bool(w_hit.all())
-                    if not preds_present:
-                        k = int(np.argmin(w_hit))
-                        blocked[v.id] = VertexID(int(wr[k]), int(ws[k]))
+                    if base:
+                        # weak targets under the pruned floor are in
+                        # finalized history — treated satisfied (they can
+                        # never be re-fetched, and ordering never descends
+                        # below the GC horizon).
+                        w_live = wr > base
+                        wr, ws = wr[w_live], ws[w_live]
+                    if wr.size:
+                        w_hit = exists[wr - base, ws]
+                        preds_present = bool(w_hit.all())
+                        if not preds_present:
+                            k = int(np.argmin(w_hit))
+                            blocked[v.id] = VertexID(int(wr[k]), int(ws[k]))
                 if preds_present:
                     blocked.pop(v.id, None)
                     self.dag.insert(v)
@@ -499,8 +529,12 @@ class Process:
         # round, so stopping the propagation at lo loses nothing above it.
         # Steady state sweeps O(1) rounds instead of O(R); cold start and
         # checkpoint restore reset the marker to 0 (full sweep).
-        lo = max(1, min(dag.insert_min_round, rnd - 1))
+        # The GC horizon also floors the sweep: rounds <= base_round are
+        # retired and excluded from delivery everywhere, so they can
+        # never need a weak edge.
+        lo = max(1, dag.base_round + 1, min(dag.insert_min_round, rnd - 1))
         dag.insert_min_round = rnd
+        dag_base = dag.base_round
         base = lo - 1  # lowest row the sweep can write (r == lo writes lo-1)
         reached = np.zeros((rnd - base, n), dtype=bool)  # rows base..rnd-1
         covered = np.zeros(n, dtype=bool)
@@ -516,7 +550,7 @@ class Process:
                         covered[u.source] = True
             if r == 1:
                 break  # round 0 is genesis; nothing below to propagate to
-            reached[r - 1 - base] |= covered @ dag.strong[r]
+            reached[r - 1 - base] |= covered @ dag.strong[r - dag_base]
             for i in np.flatnonzero(covered):
                 for (r2, j) in dag.weak.get((r, i), ()):
                     if r2 >= lo:  # below lo is never read
@@ -564,9 +598,13 @@ class Process:
         self._stuck_steps = 0
         self._sync_last_request = now
         lo: Optional[int] = None
+        floor = self.dag.base_round
         for v in self.buffer:
             for e in (*v.strong_edges, *v.weak_edges):
-                if e.round >= 1 and not self.dag.present(e):
+                # rounds at/below our GC floor are unservable everywhere
+                # (peers refuse pruned windows) and unadmittable here —
+                # requesting them would loop forever
+                if e.round > max(0, floor) and not self.dag.present(e):
                     lo = e.round if lo is None else min(lo, e.round)
         if lo is not None:
             # Anchor at our own frontier: buffered vertices only reveal
@@ -611,6 +649,15 @@ class Process:
         lo = max(1, msg.round)
         hi = msg.origin if msg.origin is not None else lo
         hi = min(hi, lo + self.cfg.sync_window - 1, self.round)
+        if lo <= self.dag.base_round:
+            # Below the GC horizon: that history is retired here (and
+            # excluded from delivery everywhere) — refuse cleanly rather
+            # than serve a partial window the requester can't use.
+            self.metrics.inc("sync_refused_pruned")
+            self.log.event(
+                "sync_refuse_pruned", lo=lo, floor=self.dag.base_round
+            )
+            return
         if hi < lo:
             return
         # Rate limit per requester (not per window — window rotation must
@@ -694,22 +741,72 @@ class Process:
             chain=len(leaders),
         )
         if self.defer_delivery:
+            # cur is the oldest leader in the chain — maybe_prune anchors
+            # the GC floor on it until the deferred walk flushes.
             self._deferred_orders.append(
-                (leaders, _time.perf_counter() - t0)
+                (leaders, _time.perf_counter() - t0, cur.round)
             )
             return
         self._order_vertices(leaders)
         self.metrics.observe_wave_commit(_time.perf_counter() - t0)
+        self.maybe_prune()
 
     def flush_deliveries(self) -> None:
         """Run queued ordering/delivery walks (see ``defer_delivery``).
         The wave-commit metric observes chain-walk + ordering as one
         sample, same as the inline path."""
         while self._deferred_orders:
-            leaders, partial = self._deferred_orders.popleft()
+            leaders, partial, _ = self._deferred_orders.popleft()
             with Timer() as t:
                 self._order_vertices(leaders)
             self.metrics.observe_wave_commit(partial + t.seconds)
+        self.maybe_prune()
+
+    def maybe_prune(self) -> int:
+        """Retire DAG/process state below the GC horizon (cfg.gc_depth).
+
+        The floor is ``oldest_undelivered_leader_round - gc_depth``: the
+        ordering rule (see _order_vertices) already guarantees no correct
+        process will ever deliver below it, so dropping that state cannot
+        diverge the total order. Pending deferred delivery walks anchor
+        the floor at their oldest leader — pruning may never outrun a
+        delivery that is merely deferred. Returns vertices removed.
+        """
+        gc = self.cfg.gc_depth
+        if gc is None or self.decided_wave == 0:
+            return 0
+        anchor = self.cfg.wave_round(self.decided_wave, 1)
+        for (_, _, oldest_round) in self._deferred_orders:
+            anchor = min(anchor, oldest_round)
+        floor = anchor - gc
+        if floor <= self.dag.base_round:
+            return 0
+        old_base = self.dag.base_round
+        removed = self.dag.prune_below(floor)
+        shift = self.dag.base_round - old_base
+        # Realign the delivered bitmap with the shifted dense rows.
+        dmask = self._delivered_mask
+        new = np.zeros_like(self.dag.exists)
+        src = dmask[shift:]
+        m = min(src.shape[0], new.shape[0])
+        new[:m] = src[:m]
+        self._delivered_mask = new
+        # Bound the book-keeping that grows with history. delivered_log
+        # keeps only the live window (the trimmed count is preserved for
+        # checkpoints/metrics); deliveries below the horizon can never
+        # recur, so dedup state for them is dead weight.
+        base = self.dag.base_round
+        if self.delivered_log and self.delivered_log[0].round < base:
+            keep = [v for v in self.delivered_log if v.round >= base]
+            self.delivered_trimmed += len(self.delivered_log) - len(keep)
+            self.delivered_log = keep
+            self.delivered = set(keep)
+        self._seen_digests = {
+            k: d for k, d in self._seen_digests.items() if k.round >= base
+        }
+        self.metrics.inc("vertices_pruned", removed)
+        self.log.event("pruned", floor=base, removed=removed)
+        return removed
 
     def _wave_leader(self, wave: int) -> Optional[Vertex]:
         """Leader lookup (reference ``getWaveVertexLeader``,
@@ -721,10 +818,11 @@ class Process:
     def _strong_reach_count(self, r_hi: int, r_lo: int, leader_src: int) -> int:
         """|{v in dag[r_hi] : strong path v -> leader}| via the dense-mirror
         matmul chain — host twin of ops.dag_kernels.wave_commit_votes."""
+        base = self.dag.base_round
         reach = np.eye(self.cfg.n, dtype=bool)
         for r in range(r_hi, r_lo, -1):
-            reach = reach @ self.dag.strong[r]
-        votes = reach[:, leader_src] & self.dag.exists[r_hi]
+            reach = reach @ self.dag.strong[r - base]
+        votes = reach[:, leader_src] & self.dag.exists[r_hi - base]
         return int(votes.sum())
 
     # ------------------------------------------------------------------
@@ -742,6 +840,8 @@ class Process:
             grown = np.zeros_like(self.dag.exists)
             grown[: dmask.shape[0]] = dmask
             self._delivered_mask = dmask = grown
+        base = self.dag.base_round
+        gc = self.cfg.gc_depth
         while not leaders.is_empty():
             leader = leaders.pop()
             # Delivered-pruned closure: identical fresh set as the full
@@ -749,14 +849,27 @@ class Process:
             # at the already-delivered frontier instead of descending the
             # whole DAG depth on every commit.
             reached = self.dag.closure_stopped(leader.id, dmask)
+            # Deterministic GC exclusion (cfg.gc_depth): vertices at
+            # round <= leader.round - gc_depth are skipped by EVERY
+            # process for the same committed leader (a pure function of
+            # the leader round), so the total order stays identical while
+            # state below the horizon becomes safely prunable. A vertex
+            # excluded at its first containing leader stays excluded at
+            # every later one (leader rounds only grow).
+            lo_round = max(1, base + 1)
+            if gc is not None:
+                lo_round = max(lo_round, leader.round - gc + 1)
             # One vectorized diff against delivered state, then touch only
             # the genuinely-new slots. argwhere's row-major order IS the
             # delivery order (ascending round, then source).
-            hi = leader.round + 1
-            fresh = reached[1:hi] & ~dmask[1:hi]
+            lo = lo_round - base
+            hi = leader.round + 1 - base
+            if hi <= lo:
+                continue
+            fresh = reached[lo:hi] & ~dmask[lo:hi]
             for rr, src in np.argwhere(fresh):
-                vid = VertexID(int(rr) + 1, int(src))
-                dmask[vid.round, vid.source] = True
+                vid = VertexID(int(rr) + lo_round, int(src))
+                dmask[vid.round - base, vid.source] = True
                 self.delivered.add(vid)
                 self.delivered_log.append(vid)
                 self.metrics.inc("vertices_delivered")
@@ -771,6 +884,8 @@ class Process:
     def _rebuild_delivered_mask(self) -> None:
         """Re-derive the dense delivered bitmap from ``delivered_log`` —
         for callers (checkpoint restore) that replace the log wholesale."""
+        base = self.dag.base_round
         self._delivered_mask = np.zeros_like(self.dag.exists)
         for vid in self.delivered_log:
-            self._delivered_mask[vid.round, vid.source] = True
+            if vid.round >= base:
+                self._delivered_mask[vid.round - base, vid.source] = True

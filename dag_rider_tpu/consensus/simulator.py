@@ -138,6 +138,13 @@ class Simulation:
                                 tf1 - tf0
                             )
                         else:
+                            if pipelined:
+                                # no overlap window in the chunked path —
+                                # flush now so deferred deliveries (and
+                                # maybe_prune) never starve when bursts
+                                # consistently exceed the fixed bucket
+                                for p in self.processes:
+                                    p.flush_deliveries()
                             with Timer() as t:
                                 mask = shared.verify_rounds(
                                     batches

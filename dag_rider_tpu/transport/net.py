@@ -19,6 +19,7 @@ stays single-threaded (SURVEY.md D4's fix) — only the inbox is shared.
 
 from __future__ import annotations
 
+import struct
 import threading
 from collections import deque
 from typing import Callable, Deque, Dict, Optional
@@ -72,9 +73,17 @@ class GrpcTransport(Transport):
         retry_backoff_s: float = 0.05,
         rpc_timeout_s: float = 5.0,
         metrics: Optional[Metrics] = None,
+        auth=None,
     ):
         self.index = index
         self._peers = dict(peers)
+        #: Optional FrameAuth (transport/auth.py): every outgoing frame
+        #: carries a per-peer MAC and every incoming frame must carry a
+        #: valid MAC for its *claimed* sender — the authenticated
+        #: point-to-point links Bracha's quorum math assumes (round-3
+        #: VERDICT missing #5: without this, any peer could forge other
+        #: processes' ECHO/READY votes on the open Deliver endpoint).
+        self._auth = auth
         self._handler: Optional[Handler] = None
         self._lock = threading.Lock()
         self._inbox: Deque[BroadcastMessage] = deque()
@@ -125,10 +134,38 @@ class GrpcTransport(Transport):
     # -- wire ----------------------------------------------------------------
 
     def _on_rpc(self, payload: bytes) -> None:
-        try:
-            msg, _ = codec.decode_message(payload)
-        except Exception:
-            return  # malformed bytes from a Byzantine peer: drop
+        if self._auth is not None:
+            # Authenticated frame: <u32 relayer> || codec message || MAC,
+            # MAC'd with the (relayer, me) pair key. The relayer is the
+            # transport-level sender; it differs from msg.sender only for
+            # relayed VALs (FETCH retransmissions and catch-up sync serve
+            # other processes' original signed vertices — those are
+            # self-certifying via the vertex signature + RBC digest
+            # votes). For every control kind, msg.sender must BE the
+            # authenticated relayer, or a single Byzantine peer could
+            # forge other processes' ECHO/READY votes / sync identities.
+            from dag_rider_tpu.transport.auth import TAG_BYTES
+
+            if len(payload) < 4 + TAG_BYTES:
+                self._inc("net_auth_rejects")
+                return
+            (relayer,) = struct.unpack_from("<I", payload)
+            body, tag = payload[4:-TAG_BYTES], payload[-TAG_BYTES:]
+            if not self._auth.check(relayer, body, tag):
+                self._inc("net_auth_rejects")
+                return
+            try:
+                msg, _ = codec.decode_message(body)
+            except Exception:
+                return  # malformed bytes from a Byzantine peer: drop
+            if msg.kind != "val" and msg.sender != relayer:
+                self._inc("net_auth_rejects")
+                return
+        else:
+            try:
+                msg, _ = codec.decode_message(payload)
+            except Exception:
+                return  # malformed bytes from a Byzantine peer: drop
         with self._lock:
             self._inbox.append(msg)
 
@@ -160,6 +197,17 @@ class GrpcTransport(Transport):
 
     def broadcast(self, msg: BroadcastMessage) -> None:
         payload = codec.encode_message(msg)
+        if self._auth is not None:
+            prefix = struct.pack("<I", self.index)
+            for peer in sorted(self._peers):
+                if peer == self.index:
+                    continue
+                self._send(
+                    peer,
+                    prefix + payload + self._auth.tag(peer, payload),
+                    attempt=0,
+                )
+            return
         for peer in sorted(self._peers):
             if peer == self.index:
                 continue

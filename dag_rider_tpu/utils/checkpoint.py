@@ -56,6 +56,12 @@ def save(process, path: str) -> None:
         "n": process.cfg.n,
         "round": process.round,
         "decided_wave": process.decided_wave,
+        # GC window cursors (absent in pre-round-4 manifests -> defaults):
+        # the vertex payloads above only cover rounds >= base_round, so a
+        # pruned node's checkpoint is automatically compacted to the live
+        # window.
+        "base_round": process.dag.base_round,
+        "delivered_trimmed": process.delivered_trimmed,
         "delivered_log": [
             [vid.round, vid.source] for vid in process.delivered_log
         ],
@@ -103,6 +109,9 @@ def restore(process, path: str) -> None:
     # gate-validated edge bounds, and a corrupted or crafted checkpoint
     # must fail safe (vertex dropped) rather than alias numpy indices.
     process.dag.reset()
+    process.dag.base_round = manifest.get("base_round", 0)
+    process.dag.max_round = process.dag.base_round
+    process.delivered_trimmed = manifest.get("delivered_trimmed", 0)
     for v in sorted(admitted, key=lambda v: (v.round, v.source)):
         if v.round >= 1 and not process.edges_valid(v):
             process.log.event(
@@ -139,9 +148,20 @@ def restore(process, path: str) -> None:
             ],
         )
     )
-    process.delivered_log = [
-        VertexID(r, s) for r, s in manifest["delivered_log"]
-    ]
+    # Bounds-validate before touching dense state: a crafted/corrupted
+    # manifest entry must fail the restore loudly, not alias a numpy
+    # index (negative source) into a silent order divergence.
+    n = process.cfg.n
+    base = process.dag.base_round
+    log = []
+    for r, s in manifest["delivered_log"]:
+        if not (0 <= s < n) or r < base or r > process.dag.max_round:
+            raise ValueError(
+                f"corrupt checkpoint: delivered entry ({r}, {s}) out of "
+                f"bounds for n={n}, base_round={base}"
+            )
+        log.append(VertexID(r, s))
+    process.delivered_log = log
     process.delivered = set(process.delivered_log)
     process._rebuild_delivered_mask()
     process.blocks_to_propose.clear()
