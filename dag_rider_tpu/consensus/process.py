@@ -101,7 +101,6 @@ class Process:
         self.blocks_to_propose: Deque[Block] = deque()
         self.decided_wave = 0
         self._pending_waves: Set[int] = set()
-        self.delivered: Set[VertexID] = set()
         self.delivered_log: List[VertexID] = []
         #: deliveries dropped from delivered_log by GC pruning (the log
         #: keeps only the live window when cfg.gc_depth is set)
@@ -800,7 +799,6 @@ class Process:
             keep = [v for v in self.delivered_log if v.round >= base]
             self.delivered_trimmed += len(self.delivered_log) - len(keep)
             self.delivered_log = keep
-            self.delivered = set(keep)
         self._seen_digests = {
             k: d for k, d in self._seen_digests.items() if k.round >= base
         }
@@ -870,7 +868,6 @@ class Process:
             for rr, src in np.argwhere(fresh):
                 vid = VertexID(int(rr) + lo_round, int(src))
                 dmask[vid.round - base, vid.source] = True
-                self.delivered.add(vid)
                 self.delivered_log.append(vid)
                 self.metrics.inc("vertices_delivered")
                 if self.on_deliver is not None:
@@ -880,6 +877,13 @@ class Process:
             count=len(self.delivered_log) - n_before,
             total=len(self.delivered_log),
         )
+
+    @property
+    def delivered(self) -> Set[VertexID]:
+        """Delivered vertex ids as a set, derived on demand —
+        ``delivered_log`` (order) and ``_delivered_mask`` (dense dedup)
+        are the authorities; nothing on the hot path reads this."""
+        return set(self.delivered_log)
 
     def _rebuild_delivered_mask(self) -> None:
         """Re-derive the dense delivered bitmap from ``delivered_log`` —

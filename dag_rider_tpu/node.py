@@ -98,10 +98,14 @@ class Node:
     def __init__(self, cfg: dict, *, log: Optional[EventLog] = None):
         n = int(cfg["n"])
         index = int(cfg["index"])
+        gc_depth = cfg.get("gc_depth")
         self.ccfg = Config(
             n=n,
             coin=cfg.get("coin", "round_robin"),
             propose_empty=bool(cfg.get("propose_empty", True)),
+            # bounded DAG memory for long-running nodes (None = grow
+            # forever, reference-compatible)
+            gc_depth=int(gc_depth) if gc_depth is not None else None,
         )
         with open(cfg["keys"]) as fh:
             reg, seeds, coin_keys = load_keys(json.load(fh))

@@ -107,37 +107,88 @@ def _gather_entry(table: Tuple[jax.Array, ...], idx: jax.Array) -> Point:
     return tuple(out)
 
 
-def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
-    """[k]P — 4-bit fixed windows, MSB first, batched over leading dims.
-
-    nibbles: int32[..., 64], little-endian. The window walk is a fori_loop
-    so the HLO stays one window long regardless of scalar size.
-    """
-    # Radix-16 table via scan: one padd body in the HLO instead of 14
-    # inlined ones (compile-time win; identical values). The identity is
-    # derived from ``p`` (0*X, 0*Y + 1, 0*Z) rather than broadcast from
-    # constants so that under shard_map the scan/fori carries inherit the
-    # batch axis's "varying" type from the inputs (shard_map rejects an
-    # unvarying carry that becomes varying after one body application).
+def _identity_like(p: Point) -> Point:
+    """Identity (0 : 1 : 0) with ``p``'s shape, DERIVED from ``p``
+    (0*X, 0*Y + 1, 0*Z) rather than broadcast from constants, so that
+    under shard_map the scan/fori carries built from it inherit the batch
+    axis's "varying" type from the inputs (shard_map rejects an unvarying
+    carry that becomes varying after one body application)."""
     one = jnp.broadcast_to(jnp.asarray(F.ONE), p[1].shape)
-    ident = (jnp.zeros_like(p[0]), jnp.zeros_like(p[1]) + one, jnp.zeros_like(p[2]))
+    return (
+        jnp.zeros_like(p[0]),
+        jnp.zeros_like(p[1]) + one,
+        jnp.zeros_like(p[2]),
+    )
+
+
+def _point_tables(p: Point) -> Tuple[jax.Array, ...]:
+    """Radix-16 multiples [0..15]P per point: coords [..., 16, LIMBS].
+
+    Built via scan — one padd body in the HLO instead of 14 inlined ones
+    (compile-time win; identical values).
+    """
+    ident = _identity_like(p)
 
     def _entry(prev, _):
         nxt = padd(prev, p)
         return nxt, nxt
 
     _, steps = jax.lax.scan(_entry, ident, None, length=15)
-    table = tuple(
+    return tuple(
         jnp.moveaxis(
             jnp.concatenate([ident[c][None], steps[c]], axis=0), 0, -2
         )
         for c in range(3)
     )
 
+
+def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
+    """[k]P — 4-bit fixed windows, MSB first, batched over leading dims.
+
+    nibbles: int32[..., 64], little-endian. The window walk is a fori_loop
+    so the HLO stays one window long regardless of scalar size. (The MSM
+    path uses :func:`window_sums` instead — this per-point ladder remains
+    for single-scalar consumers and differential tests.)
+    """
+    table = _point_tables(p)
+    ident = _identity_like(p)
+
     def body(i, acc):
         acc = pdouble(pdouble(pdouble(pdouble(acc))))
         idx = jnp.take(nibbles, WINDOWS - 1 - i, axis=-1)
         return padd(acc, _gather_entry(table, idx))
+
+    return jax.lax.fori_loop(0, WINDOWS, body, ident)
+
+
+def window_sums(nibbles: jax.Array, p: Point) -> Point:
+    """Per-window partial sums S_w = sum_i [d_{i,w}] P_i, coords [64, L].
+
+    The TPU-shaped half of the MSM (round-4; same restructuring that took
+    the Ed25519 comb from a sequential walk to a wide tree — PROFILE.md):
+    radix-16 tables per point, ONE take_along_axis gathering every
+    window's digit entry ([T, 64, L]), then a pairwise tree reduction
+    over the point axis with full batch-level ILP. Work is
+    15T (tables) + 64T (tree) complete additions versus the ladder's
+    320T, with no 64-step dependent accumulator chain over the batch.
+    """
+    table = _point_tables(p)  # [T, 16, L] per coord
+    ent = tuple(
+        jnp.take_along_axis(c, nibbles[..., None], axis=-2) for c in table
+    )  # [T, 64, L]
+    acc = tree_reduce(ent)  # [1, 64, L]
+    return tuple(c[0] for c in acc)
+
+
+def horner_combine(wsums: Point) -> Point:
+    """sum_w 16^w S_w from [64, L] window sums — 4 doublings + 1 add per
+    window on a single point (negligible next to the batch tree)."""
+    ident = _identity_like(tuple(c[0] for c in wsums))
+
+    def body(i, acc):
+        acc = pdouble(pdouble(pdouble(pdouble(acc))))
+        w = tuple(jnp.take(c, WINDOWS - 1 - i, axis=0) for c in wsums)
+        return padd(acc, w)
 
     return jax.lax.fori_loop(0, WINDOWS, body, ident)
 
@@ -171,8 +222,8 @@ def msm_kernel(
     nibbles: int32[T, 64]; px/py/pz: int32[T, 33]. Pad slots use scalar 0
     (maps to the identity). Returns one projective point (X, Y, Z) [33].
     """
-    acc = scalar_mul(nibbles, (px, py, pz))  # [T, 33] each — vmapped walk
-    return tuple(c[0] for c in tree_reduce(acc))
+    wsums = window_sums(nibbles, (px, py, pz))  # [64, 33] each
+    return horner_combine(wsums)
 
 
 # ---------------------------------------------------------------------------

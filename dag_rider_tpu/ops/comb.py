@@ -268,11 +268,12 @@ def comb_verify_core8(
     entries = stacked[..., : 4 * F.LIMBS].reshape(
         (*stacked.shape[:-1], 4, F.LIMBS)
     )
-    if impl == "pallas":
+    if impl in ("pallas", "pallas_interpret"):
         from dag_rider_tpu.ops import pallas_group
 
-        acc = pallas_group.tree_sum_xyzt(entries)
-        ok = pallas_group.finish_check(r_y, r_sign, acc)
+        interp = impl == "pallas_interpret"
+        acc = pallas_group.tree_sum_xyzt(entries, interpret=interp)
+        ok = pallas_group.finish_check(r_y, r_sign, acc, interpret=interp)
         return ok & a_valid & prevalid
     acc = tree_sum_packed(entries)
     lhs = unpack_point(acc[:, 0])
@@ -362,12 +363,13 @@ def comb_verify_core(
         (*stacked.shape[:-1], 4, F.LIMBS)
     )  # [B, 2, 64, 4, 22]
 
-    if impl == "pallas":
+    if impl in ("pallas", "pallas_interpret"):
         from dag_rider_tpu.ops import pallas_group
 
-        acc = pallas_group.tree_sum_xyzt(entries)  # [B, 2, 4, 22]
+        interp = impl == "pallas_interpret"
+        acc = pallas_group.tree_sum_xyzt(entries, interpret=interp)  # [B, 2, 4, 22]
         # decompress + rhs addition + projective equality in one launch
-        ok = pallas_group.finish_check(r_y, r_sign, acc)
+        ok = pallas_group.finish_check(r_y, r_sign, acc, interpret=interp)
         return ok & a_valid & prevalid
     acc = tree_sum_packed(entries)
     lhs = unpack_point(acc[:, 0])  # [s]B

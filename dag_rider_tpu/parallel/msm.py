@@ -45,16 +45,18 @@ def make_sharded_msm_kernel(mesh: Mesh):
         check_vma=False,
     )
     def _local(nib, px, py, pz):
-        acc = bls_msm.scalar_mul(nib, (px, py, pz))  # [T/D, LIMBS] each
-        acc = bls_msm.tree_reduce(acc)  # [1, LIMBS] local partial
-        # one collective: D partial sums -> every device, then fold
-        # (tree_reduce carries odd remainders, so non-power-of-two device
-        # counts fold correctly)
+        # per-shard window sums (tables + gather + wide tree — the
+        # round-4 MSM shape, see bls_msm.window_sums): [64, LIMBS] each
+        wsums = bls_msm.window_sums(nib, (px, py, pz))
+        # one collective: D per-window partials -> every device, then
+        # fold over the device axis (tree_reduce carries odd remainders,
+        # so non-power-of-two device counts fold correctly) and run the
+        # tiny single-point Horner combine replicated.
         gathered = tuple(
-            jax.lax.all_gather(c[0], "batch", tiled=False) for c in acc
-        )  # [D, LIMBS] each
-        out = bls_msm.tree_reduce(gathered)
-        return tuple(c[0] for c in out)
+            jax.lax.all_gather(c, "batch", tiled=False) for c in wsums
+        )  # [D, 64, LIMBS] each
+        folded = bls_msm.tree_reduce(gathered)  # [1, 64, LIMBS]
+        return bls_msm.horner_combine(tuple(c[0] for c in folded))
 
     return jax.jit(_local)
 

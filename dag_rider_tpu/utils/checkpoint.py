@@ -162,7 +162,6 @@ def restore(process, path: str) -> None:
             )
         log.append(VertexID(r, s))
     process.delivered_log = log
-    process.delivered = set(process.delivered_log)
     process._rebuild_delivered_mask()
     process.blocks_to_propose.clear()
     for txs in manifest["blocks_to_propose"]:

@@ -336,3 +336,27 @@ def test_node_through_remote_verifier_sidecar(tmp_path):
                 nd.stop()
     finally:
         sidecar.stop()
+
+
+def test_node_config_plumbs_gc_depth(tmp_path):
+    keys_path = tmp_path / "keys.json"
+    node_mod.main(
+        ["keygen", "--n", "4", "--threshold", "2", "--out", str(keys_path)]
+    )
+    nd = node_mod.Node(
+        {
+            "index": 0,
+            "n": 4,
+            "listen": "127.0.0.1:0",
+            "peers": {},
+            "keys": str(keys_path),
+            "rbc": False,
+            "verifier": "none",
+            "coin": "round_robin",
+            "gc_depth": 24,
+        }
+    )
+    try:
+        assert nd.process.cfg.gc_depth == 24
+    finally:
+        nd.net.close()
