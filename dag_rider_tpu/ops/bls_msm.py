@@ -161,7 +161,7 @@ def scalar_mul(nibbles: jax.Array, p: Point) -> Point:
     return jax.lax.fori_loop(0, WINDOWS, body, ident)
 
 
-def window_sums(nibbles: jax.Array, p: Point) -> Point:
+def window_sums(nibbles: jax.Array, p: Point, impl: str = "jnp") -> Point:
     """Per-window partial sums S_w = sum_i [d_{i,w}] P_i, coords [64, L].
 
     The TPU-shaped half of the MSM (round-4; same restructuring that took
@@ -171,11 +171,24 @@ def window_sums(nibbles: jax.Array, p: Point) -> Point:
     over the point axis with full batch-level ILP. Work is
     15T (tables) + 64T (tree) complete additions versus the ladder's
     320T, with no 64-step dependent accumulator chain over the batch.
+
+    impl: "jnp" (portable tree) or "pallas"/"pallas_interpret" — the
+    tree's additions as single Mosaic launches with all intermediates in
+    VMEM (ops/pallas_group381.py), bit-identical.
     """
     table = _point_tables(p)  # [T, 16, L] per coord
     ent = tuple(
         jnp.take_along_axis(c, nibbles[..., None], axis=-2) for c in table
     )  # [T, 64, L]
+    if impl in ("pallas", "pallas_interpret"):
+        from dag_rider_tpu.ops import pallas_group381 as PG381
+
+        stacked = jnp.stack(ent, axis=-2)  # [T, 64, 3, L]
+        stacked = jnp.moveaxis(stacked, 0, 1)  # [64, T, 3, L]
+        acc = PG381.tree_sum_xyz381(
+            stacked, interpret=impl == "pallas_interpret"
+        )  # [64, 3, L]
+        return tuple(acc[:, c] for c in range(3))
     acc = tree_reduce(ent)  # [1, 64, L]
     return tuple(c[0] for c in acc)
 
@@ -213,17 +226,40 @@ def tree_reduce(acc: Point) -> Point:
     return acc
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("impl",))
 def msm_kernel(
-    nibbles: jax.Array, px: jax.Array, py: jax.Array, pz: jax.Array
+    nibbles: jax.Array,
+    px: jax.Array,
+    py: jax.Array,
+    pz: jax.Array,
+    impl: str = "jnp",
 ) -> Point:
     """sum_i [k_i] P_i for a padded batch of T points.
 
     nibbles: int32[T, 64]; px/py/pz: int32[T, 33]. Pad slots use scalar 0
     (maps to the identity). Returns one projective point (X, Y, Z) [33].
     """
-    wsums = window_sums(nibbles, (px, py, pz))  # [64, 33] each
+    wsums = window_sums(nibbles, (px, py, pz), impl=impl)  # [64, 33] each
     return horner_combine(wsums)
+
+
+def msm_impl(t: int) -> str:
+    """Tree-impl selection, mirroring verifier.tpu._comb_impl: Mosaic
+    kernels on a real TPU backend for lane-aligned batches, portable jnp
+    everywhere else. DAGRIDER_MSM_PALLAS=0 (default 1) pins jnp — the
+    kernels are bit-identical, this is purely a speed selection."""
+    import os
+
+    if os.environ.get("DAGRIDER_MSM_PALLAS", "1").lower() in (
+        "0",
+        "false",
+        "no",
+        "off",
+    ):
+        return "jnp"
+    if t >= 128 and jax.default_backend() in ("tpu", "axon"):
+        return "pallas"
+    return "jnp"
 
 
 # ---------------------------------------------------------------------------
@@ -295,8 +331,13 @@ def msm(scalars: Sequence[int], points: Sequence[tuple]) -> Optional[tuple]:
 
     Returns an affine (x, y) tuple, or None for the identity.
     """
-    nib, px, py, pz = pack_inputs(scalars, points, _pad(len(points)))
+    t = _pad(len(points))
+    nib, px, py, pz = pack_inputs(scalars, points, t)
     X, Y, Z = msm_kernel(
-        jnp.asarray(nib), jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)
+        jnp.asarray(nib),
+        jnp.asarray(px),
+        jnp.asarray(py),
+        jnp.asarray(pz),
+        impl=msm_impl(t),
     )
     return unpack_point(X, Y, Z)

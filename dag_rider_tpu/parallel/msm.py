@@ -30,9 +30,11 @@ from dag_rider_tpu.ops import bls_msm, field381 as F
 from dag_rider_tpu.parallel.mesh import make_mesh
 
 
-def make_sharded_msm_kernel(mesh: Mesh):
+def make_sharded_msm_kernel(mesh: Mesh, impl: str = "jnp"):
     """Compile a sharded MSM over ``mesh``: int32[T, 64] nibbles +
-    int32[T, LIMBS] coords -> one projective point (replicated)."""
+    int32[T, LIMBS] coords -> one projective point (replicated).
+    ``impl`` selects the per-shard tree engine (see bls_msm.window_sums);
+    shard_map is exactly what lets the Mosaic kernels run per shard."""
 
     @functools.partial(
         jax.shard_map,
@@ -47,7 +49,7 @@ def make_sharded_msm_kernel(mesh: Mesh):
     def _local(nib, px, py, pz):
         # per-shard window sums (tables + gather + wide tree — the
         # round-4 MSM shape, see bls_msm.window_sums): [64, LIMBS] each
-        wsums = bls_msm.window_sums(nib, (px, py, pz))
+        wsums = bls_msm.window_sums(nib, (px, py, pz), impl=impl)
         # one collective: D per-window partials -> every device, then
         # fold over the device axis (tree_reduce carries odd remainders,
         # so non-power-of-two device counts fold correctly) and run the
@@ -65,10 +67,11 @@ class ShardedMSM:
     """Host seam with the same signature as :func:`ops.bls_msm.msm` —
     plugs into ``threshold.aggregate(msm=...)`` / ``ThresholdCoin``."""
 
-    def __init__(self, mesh: Optional[Mesh] = None):
+    def __init__(self, mesh: Optional[Mesh] = None, impl: Optional[str] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = int(np.prod(self.mesh.devices.shape))
-        self._kernel = make_sharded_msm_kernel(self.mesh)
+        self._impl = impl
+        self._kernels: dict = {}
 
     def __call__(
         self, scalars: Sequence[int], points: Sequence[tuple]
@@ -76,8 +79,15 @@ class ShardedMSM:
         # Same marshalling as the single-device path, padded so every
         # shard gets an equal power-of-two slice.
         t = bls_msm._pad(len(points), base=max(4, self.n_shards))
+        impl = (
+            self._impl
+            if self._impl is not None
+            else bls_msm.msm_impl(t // self.n_shards)
+        )
+        if impl not in self._kernels:
+            self._kernels[impl] = make_sharded_msm_kernel(self.mesh, impl)
         nib, px, py, pz = bls_msm.pack_inputs(scalars, points, t)
-        X, Y, Z = self._kernel(
+        X, Y, Z = self._kernels[impl](
             jnp.asarray(nib), jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)
         )
         return bls_msm.unpack_point(X, Y, Z)

@@ -1,0 +1,133 @@
+"""BLS12-381 Pallas group kernel: the in-kernel limb math IS the oracle
+math.
+
+Interpret-mode execution of the full ~30k-op addition body measures in
+minutes per launch, so (unlike the small Ed25519 kernels) bit-identity is
+asserted at the layer that actually carries the risk: the kernel body
+helpers (_carry33/_mul33/_padd381_core) are plain traceable functions
+over row lists — they are called here DIRECTLY on [1, T] rows and
+compared against ops.field381 / ops.bls_msm.padd, which the host-oracle
+tests already pin to the reference arithmetic. The pallas_call plumbing
+(BlockSpecs, row packing) is covered structurally via jax.eval_shape;
+execution on a real TPU backend is exercised by the bench MSM rung
+(DAGRIDER_MSM_PALLAS).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dag_rider_tpu.ops import bls_msm, field381 as F
+from dag_rider_tpu.ops import pallas_group381 as PG
+
+T = 5  # odd lane count: no accidental power-of-two alignment luck
+
+
+def _rows(arr: np.ndarray):
+    """[T, 33] -> kernel row list of [1, T]."""
+    return [jnp.asarray(arr[:, i][None, :]) for i in range(F.LIMBS)]
+
+
+def _unrows(rows) -> np.ndarray:
+    """row list of [1, T] -> [T, 33]."""
+    return np.concatenate([np.asarray(r) for r in rows], axis=0).T
+
+
+@pytest.fixture(scope="module")
+def operands():
+    rng = np.random.default_rng(7)
+    # reduced-range signed limbs (the invariant every op accepts)
+    a = rng.integers(-(1 << 7), (1 << 12), (T, F.LIMBS)).astype(np.int32)
+    b = rng.integers(-(1 << 7), (1 << 12), (T, F.LIMBS)).astype(np.int32)
+    return a, b
+
+
+def test_carry_add_sub_match_field381(operands):
+    a, b = operands
+    np.testing.assert_array_equal(
+        _unrows(PG._carry33(_rows(a + b))), np.asarray(F.carry(a + b))
+    )
+    np.testing.assert_array_equal(
+        _unrows(PG._add33(_rows(a), _rows(b))), np.asarray(F.add(a, b))
+    )
+    np.testing.assert_array_equal(
+        _unrows(PG._sub33(_rows(a), _rows(b))), np.asarray(F.sub(a, b))
+    )
+    np.testing.assert_array_equal(
+        _unrows(PG._mul_small33(_rows(a), 12)),
+        np.asarray(F.mul_small(a, 12)),
+    )
+
+
+def test_mul33_matches_field381_mul(operands):
+    a, b = operands
+    np.testing.assert_array_equal(
+        _unrows(PG._mul33(_rows(a), _rows(b))), np.asarray(F.mul(a, b))
+    )
+    # and the reduction really is mod p
+    got = F.from_limbs(np.asarray(F.canonical(F.mul(a, b)))[0])
+    want = (
+        F.from_limbs(a[0].astype(np.int64))
+        * F.from_limbs(b[0].astype(np.int64))
+    ) % F.P_INT
+    assert got == want
+
+
+def test_padd_core_matches_bls_msm_padd():
+    """The whole kernel addition body vs the jnp complete addition, on
+    REAL curve points (doubling, mixed, identity-involving cases all flow
+    through the complete formulas)."""
+    from dag_rider_tpu.crypto import bls12381 as bls
+
+    pts = []
+    acc = bls.G1_GEN
+    for _ in range(T):
+        pts.append(acc)
+        acc = bls.g1_double(acc)
+    a = np.stack([np.stack([F.to_limbs(p[0]), F.to_limbs(p[1]), F.ONE]) for p in pts])
+    b = np.roll(a, 1, axis=0)
+    b[0] = np.stack([F.ZERO, F.ONE, F.ZERO])  # identity operand too
+    pa = tuple(jnp.asarray(a[:, c]) for c in range(3))
+    pb = tuple(jnp.asarray(b[:, c]) for c in range(3))
+    want = bls_msm.padd(pa, pb)
+
+    rows_a = [[_rows(a[:, c])[i] for i in range(F.LIMBS)] for c in range(3)]
+    rows_b = [[_rows(b[:, c])[i] for i in range(F.LIMBS)] for c in range(3)]
+    got = PG._padd381_core(
+        [rows_a[0], rows_a[1], rows_a[2]], [rows_b[0], rows_b[1], rows_b[2]]
+    )
+    for c in range(3):
+        np.testing.assert_array_equal(_unrows(got[c]), np.asarray(want[c]))
+
+
+def test_padd381_pallas_program_traces():
+    """pallas_call plumbing: block specs, row packing, output shape."""
+    n = 256
+    spec = jax.ShapeDtypeStruct((PG.ROWS, n), jnp.int32)
+    out = jax.eval_shape(lambda p, q: PG.padd381_xx(p, q), spec, spec)
+    assert out.shape == (PG.ROWS, n) and out.dtype == jnp.int32
+    ent = jax.ShapeDtypeStruct((64, 8, 3, F.LIMBS), jnp.int32)
+    out = jax.eval_shape(lambda e: PG.tree_sum_xyz381(e), ent)
+    assert out.shape == (64, 3, F.LIMBS)
+
+
+def test_msm_kernel_pallas_impl_traces():
+    """The full MSM program with the pallas tree engine traces end to
+    end (impl plumbed through window_sums)."""
+    t = 256
+    nib = jax.ShapeDtypeStruct((t, 64), jnp.int32)
+    co = jax.ShapeDtypeStruct((t, F.LIMBS), jnp.int32)
+    out = jax.eval_shape(
+        lambda n, x, y, z: bls_msm.msm_kernel(n, x, y, z, impl="pallas"),
+        nib, co, co, co,
+    )
+    assert tuple(o.shape for o in out) == ((F.LIMBS,),) * 3
+
+
+def test_msm_impl_selection(monkeypatch):
+    assert bls_msm.msm_impl(64) == "jnp"  # sub-lane batches stay portable
+    monkeypatch.setenv("DAGRIDER_MSM_PALLAS", "0")
+    assert bls_msm.msm_impl(4096) == "jnp"
