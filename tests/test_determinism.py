@@ -107,3 +107,44 @@ def test_cpu_vs_device_verifier_commit_order_byte_identical():
     dev_logs = run(TPUVerifier)
     assert any(cpu_logs), "nothing delivered"
     assert cpu_logs == dev_logs
+
+
+def test_pipelined_coalesced_path_matches_sync_path():
+    """The round-4 pipeline (async dispatch + deferred delivery flush in
+    Simulation.run) must not change ANY delivery: same config driven once
+    through the shared-verifier pipelined path and once through plain
+    per-process synchronous verifiers gives byte-identical logs."""
+    from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+    from dag_rider_tpu.verifier.cpu import CPUVerifier
+    from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+    n = 8
+    reg, seeds = KeyRegistry.generate(n)
+    signers = [VertexSigner(s) for s in seeds]
+    logs = []
+    for mode in ("pipelined", "sync"):
+        cfg = Config(n=n, coin="round_robin", propose_empty=True)
+        if mode == "pipelined":
+            shared = TPUVerifier(reg)
+            shared.fixed_bucket = 128
+            vf = lambda i: shared  # noqa: E731
+        else:
+            vf = lambda i: CPUVerifier(reg)  # noqa: E731
+        sim = Simulation(
+            cfg,
+            verifier_factory=vf,
+            signer_factory=lambda i: signers[i],
+        )
+        sim.submit_blocks(per_process=2)
+        for _ in range(24):
+            sim.run(max_messages=n * (n - 1))
+        sim.check_agreement()
+        logs.append(
+            [
+                (v.id.round, v.id.source, v.digest())
+                for v in sim.deliveries[0]
+            ]
+        )
+    assert len(logs[0]) > 50
+    k = min(len(logs[0]), len(logs[1]))
+    assert logs[0][:k] == logs[1][:k]
