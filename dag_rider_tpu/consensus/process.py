@@ -115,6 +115,11 @@ class Process:
         self._stuck_steps = 0
         self._sync_last_request = float("-inf")
         self._sync_last_serve: Dict[int, float] = {}  # requester -> mono
+        #: responder -> GC floor from sync_nack replies; f+1 distinct
+        #: floors above our round flip state_transfer_needed (the node
+        #: runtime acts on it — Process has no transport-level RPC).
+        self._horizon_nacks: Dict[int, int] = {}
+        self.state_transfer_needed = False
         self._seen_digests: Dict[VertexID, bytes] = {}
         self.metrics = Metrics()
         self._started = False
@@ -181,6 +186,9 @@ class Process:
         self.metrics.inc("msgs_received")
         if msg.kind == "sync":
             self._serve_sync(msg)
+            return
+        if msg.kind == "sync_nack":
+            self._on_sync_nack(msg)
             return
         if msg.kind != "val" or msg.vertex is None:
             # RBC control traffic (echo/ready/fetch) is consumed by the
@@ -639,6 +647,35 @@ class Process:
             )
         )
 
+    def _on_sync_nack(self, msg: BroadcastMessage) -> None:
+        """A responder's "your window is below my GC floor" signal.
+
+        Once f+1 DISTINCT responders (at least one honest) report floors
+        above our round, anti-entropy can never close the gap —
+        ``state_transfer_needed`` flips and the node runtime fetches a
+        peer snapshot (utils.checkpoint.restore_from_snapshot). Floors at
+        or below our round are stale/irrelevant and clear that
+        responder's entry (progress may have resumed)."""
+        if (
+            not 0 <= msg.sender < self.cfg.n
+            or msg.sender == self.index
+            or msg.origin != self.index
+        ):
+            return
+        floor = msg.round
+        if floor > self.round:
+            self._horizon_nacks[msg.sender] = floor
+            self.metrics.inc("sync_nacks")
+            if len(self._horizon_nacks) >= self.cfg.f + 1:
+                if not self.state_transfer_needed:
+                    self.log.event(
+                        "behind_horizon",
+                        floors=sorted(self._horizon_nacks.values()),
+                    )
+                self.state_transfer_needed = True
+        else:
+            self._horizon_nacks.pop(msg.sender, None)
+
     def _serve_sync(self, msg: BroadcastMessage) -> None:
         # Requester id is range-checked (spoofable in-protocol, but the
         # throttle table stays bounded at n entries) and self-requests are
@@ -648,20 +685,14 @@ class Process:
         lo = max(1, msg.round)
         hi = msg.origin if msg.origin is not None else lo
         hi = min(hi, lo + self.cfg.sync_window - 1, self.round)
-        if lo <= self.dag.base_round:
-            # Below the GC horizon: that history is retired here (and
-            # excluded from delivery everywhere) — refuse cleanly rather
-            # than serve a partial window the requester can't use.
-            self.metrics.inc("sync_refused_pruned")
-            self.log.event(
-                "sync_refuse_pruned", lo=lo, floor=self.dag.base_round
-            )
-            return
-        if hi < lo:
+        if hi < lo and lo > self.dag.base_round:
             return
         # Rate limit per requester (not per window — window rotation must
         # not multiply the budget, and a lost response must be
-        # re-requestable once the cooldown passes).
+        # re-requestable once the cooldown passes). The below-horizon
+        # nack path shares this throttle: the requester id is spoofable
+        # in-protocol, and an unthrottled nack broadcast would be an n^2
+        # traffic amplifier.
         now = _time.monotonic()
         if (
             now - self._sync_last_serve.get(msg.sender, float("-inf"))
@@ -670,6 +701,27 @@ class Process:
             self.metrics.inc("sync_throttled")
             return
         self._sync_last_serve[msg.sender] = now
+        if lo <= self.dag.base_round:
+            # Below the GC horizon: that history is retired here (and
+            # excluded from delivery everywhere) — refuse cleanly rather
+            # than serve a partial window the requester can't use, and
+            # tell the requester WHY (sync_nack with our floor): f+1
+            # such nacks are its signal that anti-entropy cannot help
+            # and peer state transfer (snapshot sync) is needed.
+            self.metrics.inc("sync_refused_pruned")
+            self.log.event(
+                "sync_refuse_pruned", lo=lo, floor=self.dag.base_round
+            )
+            self.transport.broadcast(
+                BroadcastMessage(
+                    vertex=None,
+                    round=self.dag.base_round,
+                    sender=self.index,
+                    kind="sync_nack",
+                    origin=msg.sender,
+                )
+            )
+            return
         count = 0
         for r in range(lo, hi + 1):
             for v in self.dag.vertices_in_round(r):

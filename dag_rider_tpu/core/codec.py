@@ -76,7 +76,7 @@ def decode_vertex(data: bytes, offset: int = 0) -> Tuple[Vertex, int]:
     return v, offset
 
 
-_KINDS = ("val", "echo", "ready", "fetch", "sync")
+_KINDS = ("val", "echo", "ready", "fetch", "sync", "sync_nack")
 
 
 def encode_message(msg: BroadcastMessage) -> bytes:

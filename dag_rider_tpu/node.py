@@ -129,7 +129,16 @@ class Node:
             from dag_rider_tpu.transport.auth import FrameAuth
 
             auth = FrameAuth.for_node(bytes.fromhex(master_hex), index, n)
-        self.net = GrpcTransport(index, cfg["listen"], peers, auth=auth)
+        self.net = GrpcTransport(
+            index,
+            cfg["listen"],
+            peers,
+            auth=auth,
+            # Peer state transfer (elastic recovery past the GC horizon):
+            # serve our live DAG window; it is self-certifying, see
+            # utils.checkpoint.restore_from_snapshot.
+            snapshot_provider=lambda: checkpoint.snapshot_bytes(self.process),
+        )
         transport = self.net
         if cfg.get("rbc", True):
             transport = RbcTransport(self.net, index, n, self.ccfg.f)
@@ -191,6 +200,9 @@ class Node:
         self.net.attach_metrics(self.process.metrics)
         self.ckpt_dir = cfg.get("checkpoint_dir")
         self.ckpt_every = float(cfg.get("checkpoint_every_s", 30))
+        #: per-peer state-transfer fetch deadline — short, because the
+        #: fetch runs on the pump thread (one candidate per cycle)
+        self.snapshot_timeout_s = float(cfg.get("snapshot_timeout_s", 5.0))
         self.submit_interval = float(cfg.get("submit_interval_s", 0))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -304,10 +316,50 @@ class Node:
 
     def _pump_once(self) -> None:
         self._drain_submissions()
+        if self.process.state_transfer_needed:
+            self._state_transfer()
         moved = self.net.pump(256)
         self.process.step()
         if not moved:
             time.sleep(0.002)
+
+    def _state_transfer(self) -> None:
+        """f+1 peers reported GC floors above our round (sync_nack):
+        anti-entropy cannot help, so fetch a peer's live window and
+        replay it (utils.checkpoint.restore_from_snapshot — signatures
+        verified, consensus state recomputed locally, atomic on
+        failure). Runs on the pump thread, which owns all Process state
+        — so at most ONE candidate is tried per pump cycle with a short
+        RPC deadline (a dead peer must not stall consensus pumping for
+        tens of seconds; the next cycle tries the next candidate). The
+        highest-reported floor goes first (the most caught-up donor);
+        when every candidate has failed, the flag clears and nacks must
+        re-accrue before another attempt (no hot fetch loop against
+        dead/Byzantine peers)."""
+        nacks = self.process._horizon_nacks
+        if not nacks:
+            self.process.state_transfer_needed = False
+            self.log.event("state_transfer_failed")
+            return
+        peer = max(nacks, key=nacks.get)
+        nacks.pop(peer)  # consumed: success clears the rest, failure moves on
+        blob = self.net.fetch_snapshot(
+            peer, timeout_s=self.snapshot_timeout_s
+        )
+        if blob and checkpoint.restore_from_snapshot(
+            self.process, blob, verifier=self.process.verifier
+        ):
+            self.log.event(
+                "state_transferred",
+                peer=peer,
+                round=self.process.round,
+                base=self.process.dag.base_round,
+            )
+            return
+        self.log.event("state_transfer_attempt_failed", peer=peer)
+        if not nacks:
+            self.process.state_transfer_needed = False
+            self.log.event("state_transfer_failed")
 
 
 # ----------------------------------------------------------------------

@@ -33,25 +33,84 @@ from dag_rider_tpu.utils.metrics import Metrics
 
 _SERVICE = "dagrider.Transport"
 _METHOD = f"/{_SERVICE}/Deliver"
+_SNAPSHOT_METHOD = f"/{_SERVICE}/Snapshot"
 
 _identity = lambda b: b  # noqa: E731 — bytes in, bytes out
 
 
+_SNAP_DOMAIN = b"dagrider-snapshot-req"
+
+
 class _DeliverHandler(grpc.GenericRpcHandler):
-    def __init__(self, sink: Callable[[bytes], None]):
+    def __init__(
+        self,
+        sink: Callable[[bytes], None],
+        snapshot_provider: Optional[Callable[[], bytes]] = None,
+        auth=None,
+        snapshot_min_interval_s: float = 1.0,
+    ):
         self._sink = sink
+        self._snapshot = snapshot_provider
+        self._auth = auth
+        self._snap_lock = threading.Lock()
+        self._snap_last = float("-inf")
+        self._snap_min_interval = snapshot_min_interval_s
 
     def service(self, handler_call_details):
-        if handler_call_details.method != _METHOD:
-            return None
+        if handler_call_details.method == _METHOD:
 
-        def unary(request: bytes, context) -> bytes:
-            self._sink(request)
-            return b"\x01"
+            def unary(request: bytes, context) -> bytes:
+                self._sink(request)
+                return b"\x01"
 
-        return grpc.unary_unary_rpc_method_handler(
-            unary, request_deserializer=_identity, response_serializer=_identity
-        )
+            return grpc.unary_unary_rpc_method_handler(
+                unary,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+        if (
+            handler_call_details.method == _SNAPSHOT_METHOD
+            and self._snapshot is not None
+        ):
+            # Peer state transfer: serve the live DAG window. The payload
+            # is self-certifying (signed vertices) — see
+            # utils.checkpoint.restore_from_snapshot's trust model — so
+            # INTEGRITY needs nothing here; AVAILABILITY does: each
+            # response serializes the whole window, so requests are
+            # MAC-gated (when frame auth is configured) and globally
+            # rate-limited — a 0-byte request must not be a cheap
+            # CPU/bandwidth amplifier. Empty response = refusal; the
+            # honest recovery path just retries after a pump cycle.
+            def snap(request: bytes, context) -> bytes:
+                if self._auth is not None:
+                    from dag_rider_tpu.transport.auth import TAG_BYTES
+
+                    if len(request) != 4 + TAG_BYTES:
+                        return b""
+                    (relayer,) = struct.unpack_from("<I", request)
+                    if not self._auth.check(
+                        relayer, _SNAP_DOMAIN, request[4:]
+                    ):
+                        return b""
+                import time as _t
+
+                with self._snap_lock:
+                    now = _t.monotonic()
+                    if now - self._snap_last < self._snap_min_interval:
+                        return b""
+                    self._snap_last = now
+                try:
+                    return self._snapshot()
+                except Exception:  # noqa: BLE001 — a failing provider
+                    # must not crash the server thread; empty = refuse
+                    return b""
+
+            return grpc.unary_unary_rpc_method_handler(
+                snap,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+        return None
 
 
 class GrpcTransport(Transport):
@@ -74,6 +133,7 @@ class GrpcTransport(Transport):
         rpc_timeout_s: float = 5.0,
         metrics: Optional[Metrics] = None,
         auth=None,
+        snapshot_provider: Optional[Callable[[], bytes]] = None,
     ):
         self.index = index
         self._peers = dict(peers)
@@ -113,7 +173,9 @@ class GrpcTransport(Transport):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers)
         )
-        self._server.add_generic_rpc_handlers((_DeliverHandler(self._on_rpc),))
+        self._server.add_generic_rpc_handlers(
+            (_DeliverHandler(self._on_rpc, snapshot_provider, auth),)
+        )
         self.bound_port = self._server.add_insecure_port(listen_addr)
         self._server.start()
 
@@ -306,6 +368,35 @@ class GrpcTransport(Transport):
     def pending(self) -> int:
         with self._lock:
             return len(self._inbox)
+
+    def fetch_snapshot(
+        self, peer: int, timeout_s: float = 30.0
+    ) -> Optional[bytes]:
+        """Blocking state-transfer fetch from one peer; None on failure
+        or refusal (empty response). Caller validates the bytes
+        (checkpoint.restore_from_snapshot) and tries other peers."""
+        if peer == self.index or peer not in self._peers:
+            return None
+        self._inc("net_snapshot_fetches")
+        req = b""
+        if self._auth is not None:
+            req = struct.pack("<I", self.index) + self._auth.tag(
+                peer, _SNAP_DOMAIN
+            )
+        try:
+            self._stub(peer)  # ensures the peer channel exists (locked)
+            with self._lock:
+                chan = self._channels[peer]
+            call = chan.unary_unary(
+                _SNAPSHOT_METHOD,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            blob = call(req, timeout=timeout_s)
+        except grpc.RpcError:
+            self._inc("net_snapshot_errors")
+            return None
+        return bytes(blob) if blob else None
 
     def peer_status(self) -> Dict[int, str]:
         """Failure-detector view: peer -> "up" | "down" (down = at least

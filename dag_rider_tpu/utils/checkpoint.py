@@ -170,6 +170,181 @@ def restore(process, path: str) -> None:
         )
 
 
+# ---------------------------------------------------------------------------
+# Peer state transfer (snapshot sync) — the elastic-recovery path for a node
+# that fell below the cluster's GC horizon (anti-entropy sync is refused for
+# pruned windows; see Process._serve_sync / _on_sync_nack).
+#
+# Trust model: the donor is UNTRUSTED. The snapshot carries only
+# self-certifying data — the donor's live vertex window (every round>=1
+# vertex is Ed25519-signed by its author) plus the window floor. The
+# receiver verifies every signature, re-runs the admission gate, and
+# REPLAYS consensus locally (coin shares ride on the vertices), so
+# decided/delivered state is recomputed, never imported. A lying floor
+# (higher than honest) would shrink the window below gc_depth and is
+# rejected; a censored window breaks admission/quorum chains and fails the
+# same check — the caller then tries another peer.
+# ---------------------------------------------------------------------------
+
+
+def snapshot_bytes(process) -> bytes:
+    """Serialize the live DAG window for peer state transfer.
+
+    May be called from a serving thread (the Snapshot RPC handler) while
+    the pump thread mutates the DAG: the vertex objects are immutable, so
+    the only hazard is the dict changing size mid-copy — retried with a
+    base-cursor consistency check (a single C-level ``list()`` copy per
+    attempt keeps the race window tiny)."""
+    for _ in range(8):
+        base = process.dag.base_round
+        try:
+            vertices = list(process.dag.vertices.values())
+        except RuntimeError:  # resized mid-iteration: retry
+            continue
+        top = process.dag.max_round
+        if process.dag.base_round != base:
+            continue  # pruned mid-copy: the window moved, retry
+        head = json.dumps(
+            {
+                "version": 1,
+                "n": process.cfg.n,
+                "base_round": base,
+                "max_round": top,
+            }
+        ).encode()
+        out = [struct.pack("<I", len(head)), head]
+        for v in vertices:
+            if v.round < base:
+                continue  # retired while copying
+            payload = codec.encode_vertex(v)
+            out.append(struct.pack("<I", len(payload)))
+            out.append(payload)
+        return b"".join(out)
+    return b""  # persistently racing prunes: refuse this request
+
+
+def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
+    """Rebuild a process (fresh OR live-but-stuck — the node runtime
+    calls this on its started process from the pump thread) from an
+    untrusted peer snapshot. ATOMIC: the window is validated and staged
+    into a scratch DagState first, and the process is only touched on
+    full success — a malicious or broken snapshot returns False with the
+    caller's state completely intact (a single Byzantine donor must not
+    be able to wipe a victim's live DAG).
+
+    ``verifier``: the Verifier seam used to batch-check every round>=1
+    vertex signature; None skips signature checks (signature-less
+    deployments only — matching the reference's no-crypto mode).
+    """
+    from dag_rider_tpu.consensus.dag_state import DagState
+    from dag_rider_tpu.core.types import Vertex as _V
+
+    try:
+        (hlen,) = struct.unpack_from("<I", blob, 0)
+        head = json.loads(blob[4 : 4 + hlen])
+        offset = 4 + hlen
+        vertices = []
+        while offset < len(blob):
+            (ln,) = struct.unpack_from("<I", blob, offset)
+            offset += 4
+            v, _ = codec.decode_vertex(blob[offset : offset + ln])
+            offset += ln
+            vertices.append(v)
+    except Exception:
+        return False
+    if head.get("n") != process.cfg.n or head.get("version") != 1:
+        return False
+    try:
+        base = int(head.get("base_round", 0))
+    except (TypeError, ValueError):
+        return False
+    if base < 0:
+        return False
+    gc = process.cfg.gc_depth
+    signed = [v for v in vertices if v.round >= 1]
+    if verifier is not None:
+        ok = verifier.verify_batch(signed)
+        good = {v.id for v, m in zip(signed, ok) if m}
+    else:
+        good = {v.id for v in signed}
+    usable = [
+        v
+        for v in sorted(vertices, key=lambda v: (v.round, v.source))
+        if v.round >= max(base, 1)
+        and v.id in good
+        and process.edges_valid(v)
+    ]
+
+    # ---- stage into a scratch DAG (the live process stays untouched) ----
+    staged = DagState(process.cfg)
+    staged.base_round = base
+    staged.max_round = base
+    have: set = set()
+    if base == 0:
+        for i in range(process.cfg.n):
+            staged.insert(_V(id=VertexID(0, i)))
+        have = {(0, i) for i in range(process.cfg.n)}
+    accepted = []
+    for v in usable:
+        # Edges must be satisfied within the snapshot itself (round base
+        # is the axiom row — its predecessors were retired by the donor's
+        # GC, which the ordering-exclusion rule already finalized; weak
+        # targets at or below the floor are final for the same reason).
+        if v.round > base:
+            _, ss, wr, ws = v.edge_arrays()
+            if any((v.round - 1, int(s)) not in have for s in ss) or any(
+                int(r) > base and (int(r), int(s2)) not in have
+                for r, s2 in zip(wr, ws)
+            ):
+                continue
+        staged.insert(v)
+        have.add((v.round, v.source))
+        accepted.append(v)
+    top = staged.max_round
+    # Window-width check: an honest donor's window spans >= gc_depth
+    # rounds AFTER filtering (floor = decided_r1 - gc_depth and the
+    # frontier sits at or above decided_r1). A lying floor, a censored
+    # window, or broken admission chains all fail here and the snapshot
+    # is refused wholesale.
+    if gc is not None and top - base < gc:
+        return False
+
+    # ---- commit: swap the staged window in and reset replay state ----
+    # Replay cursors: the consensus state machine resumes at the
+    # frontier; wave decisions from here retro-walk the imported window
+    # (pruned leaders below the floor terminate the chain), and the GC
+    # ordering rule keeps this node's deliveries the exact suffix every
+    # correct process emits above the horizon. Live admission state from
+    # the pre-transfer view (buffer, memos, pending verifies) is dropped
+    # wholesale — live traffic re-supplies anything still relevant.
+    process.dag = staged
+    process.buffer = []
+    process._buffered_ids = set()
+    process._blocked_on = {}
+    process._pending_verify = []
+    process._pending_verify_ids = set()
+    process._stuck_steps = 0
+    process._seen_digests = {v.id: v.digest() for v in accepted}
+    for v in accepted:
+        process._observe_coin_share(v)
+    process.round = top
+    process.decided_wave = 0
+    process._waves_tried = set()
+    process._pending_waves = set()
+    process._deferred_orders.clear()
+    process.delivered_log = []
+    process.delivered_trimmed = 0
+    process._rebuild_delivered_mask()
+    process.state_transfer_needed = False
+    process._horizon_nacks.clear()
+    inserted = len(accepted)
+    process.metrics.inc("state_transfers")
+    process.log.event(
+        "state_transfer", base=base, top=top, vertices=inserted
+    )
+    return True
+
+
 def latest_round(path: str) -> Optional[int]:
     """Peek a checkpoint's round cursor without loading it."""
     try:

@@ -101,15 +101,18 @@ def test_pruned_node_serves_sync_within_window_refuses_below():
     outbox = []
     p.transport.broadcast = lambda msg: outbox.append(msg)  # capture serves
 
-    # request below the horizon -> clean refusal, nothing served
+    # request below the horizon -> clean refusal: no vertices served,
+    # just the sync_nack that steers the requester to state transfer
     p._sync_last_serve.clear()
     p._serve_sync(
         BroadcastMessage(
             vertex=None, round=base - 1, sender=1, kind="sync", origin=base
         )
     )
-    assert outbox == []
+    assert [m.kind for m in outbox] == ["sync_nack"]
+    assert outbox[0].round == base and outbox[0].origin == 1
     assert p.metrics.counters.get("sync_refused_pruned", 0) == 1
+    outbox.clear()
 
     # request within the live window -> served from the original vertices
     lo = base + 1
