@@ -666,11 +666,17 @@ class Process:
         if floor > self.round:
             self._horizon_nacks[msg.sender] = floor
             self.metrics.inc("sync_nacks")
-            if len(self._horizon_nacks) >= self.cfg.f + 1:
+            # Threshold over CURRENTLY-live floors only: entries recorded
+            # while briefly behind must not linger and let a single later
+            # Byzantine nack fake the f+1 quorum after we caught up.
+            live = {
+                k: v for k, v in self._horizon_nacks.items() if v > self.round
+            }
+            self._horizon_nacks = live
+            if len(live) >= self.cfg.f + 1:
                 if not self.state_transfer_needed:
                     self.log.event(
-                        "behind_horizon",
-                        floors=sorted(self._horizon_nacks.values()),
+                        "behind_horizon", floors=sorted(live.values())
                     )
                 self.state_transfer_needed = True
         else:

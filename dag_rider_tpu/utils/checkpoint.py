@@ -232,6 +232,13 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     caller's state completely intact (a single Byzantine donor must not
     be able to wipe a victim's live DAG).
 
+    Refused outright (False) when: cfg.gc_depth is None (the exclusion
+    rule is what makes the import sound), the claimed floor does not
+    strictly exceed our round (a no-progress/rewind snapshot would
+    duplicate deliveries — normal sync covers that case), the window is
+    thinner than gc_depth after filtering, a duplicate (round, source)
+    appears, or the bytes/committee are wrong.
+
     ``verifier``: the Verifier seam used to batch-check every round>=1
     vertex signature; None skips signature checks (signature-less
     deployments only — matching the reference's no-crypto mode).
@@ -256,11 +263,25 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
         return False
     try:
         base = int(head.get("base_round", 0))
+        head_max = int(head.get("max_round", 1 << 62))
     except (TypeError, ValueError):
         return False
     if base < 0:
         return False
     gc = process.cfg.gc_depth
+    if gc is None:
+        # State transfer is only sound under the GC ordering-exclusion
+        # rule (it is what makes rounds below the floor undeliverable
+        # everywhere); without it, importing a window and resetting
+        # delivery state could duplicate or lose deliveries.
+        return False
+    if base <= process.round:
+        # No-progress (or REWIND) snapshot: our round already covers the
+        # claimed floor, so ordinary anti-entropy sync can serve us — and
+        # accepting it would reset delivered state for rounds we already
+        # emitted (duplicate a_deliver). Only windows strictly above our
+        # progress are state-transfer material.
+        return False
     signed = [v for v in vertices if v.round >= 1]
     if verifier is not None:
         ok = verifier.verify_batch(signed)
@@ -297,10 +318,19 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
                 for r, s2 in zip(wr, ws)
             ):
                 continue
-        staged.insert(v)
+        try:
+            staged.insert(v)
+        except ValueError:
+            # duplicate (round, source) in the snapshot: an equivocating
+            # pair smuggled past the donor's RBC, or plain corruption —
+            # either way the window is ambiguous; refuse it wholesale
+            # (the ATOMIC contract: the live process stays untouched).
+            return False
         have.add((v.round, v.source))
         accepted.append(v)
     top = staged.max_round
+    if top > head_max:
+        return False  # header inconsistent with its own payload
     # Window-width check: an honest donor's window spans >= gc_depth
     # rounds AFTER filtering (floor = decided_r1 - gc_depth and the
     # frontier sits at or above decided_r1). A lying floor, a censored

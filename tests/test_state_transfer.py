@@ -240,3 +240,62 @@ def test_node_rejoins_past_horizon_via_snapshot(tmp_path):
     finally:
         for nd in nodes + ([late] if late is not None else []):
             nd.stop()
+
+
+def test_snapshot_rejects_rewind_and_requires_gc():
+    """Round-4 review hardening: (a) a valid-but-old window must not
+    rewind a live node (duplicate a_deliver), (b) without gc_depth the
+    import semantics are unsound and the function refuses, (c) a
+    duplicate (round, source) pair — equivocation smuggled past the
+    donor's RBC — refuses atomically instead of raising mid-commit."""
+    sim = _pruned_donor()
+    donor = sim.processes[0]
+    blob = checkpoint.snapshot_bytes(donor)
+
+    # (a) receiver already ahead of the claimed floor -> refuse untouched
+    ahead = Process(GC, 0, InMemoryTransport())
+    ahead.round = donor.dag.max_round + 5
+    before = dict(ahead.dag.vertices)
+    assert not checkpoint.restore_from_snapshot(ahead, blob)
+    assert ahead.round == donor.dag.max_round + 5
+    assert dict(ahead.dag.vertices) == before
+
+    # (b) no gc_depth -> refuse
+    plain = Process(
+        Config(n=4, coin="round_robin", propose_empty=True),
+        0,
+        InMemoryTransport(),
+    )
+    assert not checkpoint.restore_from_snapshot(plain, blob)
+
+    # (c) duplicate id in the payload -> atomic refusal, no exception
+    from dag_rider_tpu.core import codec as _codec
+
+    dup = donor.dag.vertices_in_round(donor.dag.max_round)[0]
+    payload = _codec.encode_vertex(dup)
+    forged = blob + struct.pack("<I", len(payload)) + payload
+    fresh = Process(GC, 0, InMemoryTransport())
+    assert not checkpoint.restore_from_snapshot(fresh, forged)
+    assert fresh.dag.base_round == 0 and fresh.round == 0
+
+
+def test_stale_nacks_do_not_count_after_catching_up():
+    """A floor recorded while briefly behind must not combine with one
+    later Byzantine nack into a fake f+1 quorum (round-4 review)."""
+    p = Process(GC, 0, InMemoryTransport())
+    p.round = 40
+    p._on_sync_nack(
+        BroadcastMessage(
+            vertex=None, round=50, sender=1, kind="sync_nack", origin=0
+        )
+    )
+    assert not p.state_transfer_needed  # 1 < f+1
+    p.round = 100  # caught up via normal sync
+    p._on_sync_nack(
+        BroadcastMessage(
+            vertex=None, round=10**9, sender=2, kind="sync_nack", origin=0
+        )
+    )
+    # the stale floor-50 entry was purged; one live nack is not a quorum
+    assert not p.state_transfer_needed
+    assert list(p._horizon_nacks) == [2]
