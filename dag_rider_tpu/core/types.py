@@ -154,12 +154,17 @@ class Vertex:
             return cached
         import numpy as np
 
+        # int64: wire rounds/sources are u32, which OVERFLOWS int32 —
+        # a crafted vertex with round >= 2^31 must reach the admission
+        # gate's range checks as a value, not as an OverflowError on the
+        # network path (found by the snapshot corruption fuzz). The gate
+        # bounds everything to [0, n) x [0, vr) before any index use.
         se, we = self.strong_edges, self.weak_edges
         arrs = (
-            np.fromiter((e.round for e in se), np.int32, len(se)),
-            np.fromiter((e.source for e in se), np.int32, len(se)),
-            np.fromiter((e.round for e in we), np.int32, len(we)),
-            np.fromiter((e.source for e in we), np.int32, len(we)),
+            np.fromiter((e.round for e in se), np.int64, len(se)),
+            np.fromiter((e.source for e in se), np.int64, len(se)),
+            np.fromiter((e.round for e in we), np.int64, len(we)),
+            np.fromiter((e.source for e in we), np.int64, len(we)),
         )
         object.__setattr__(self, "_edge_arrays", arrs)
         return arrs

@@ -259,6 +259,8 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
             vertices.append(v)
     except Exception:
         return False
+    if not isinstance(head, dict):
+        return False  # valid JSON, wrong shape (e.g. a bare list/number)
     if head.get("n") != process.cfg.n or head.get("version") != 1:
         return False
     try:
@@ -335,8 +337,9 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     # rounds AFTER filtering (floor = decided_r1 - gc_depth and the
     # frontier sits at or above decided_r1). A lying floor, a censored
     # window, or broken admission chains all fail here and the snapshot
-    # is refused wholesale.
-    if gc is not None and top - base < gc:
+    # is refused wholesale. (gc is non-None here — gc-less configs were
+    # refused up front.)
+    if top - base < gc:
         return False
 
     # ---- commit: swap the staged window in and reset replay state ----

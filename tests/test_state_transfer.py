@@ -299,3 +299,53 @@ def test_stale_nacks_do_not_count_after_catching_up():
     # the stale floor-50 entry was purged; one live nack is not a quorum
     assert not p.state_transfer_needed
     assert list(p._horizon_nacks) == [2]
+
+
+def test_snapshot_corruption_fuzz_never_crashes_or_corrupts():
+    """Seeded fuzz over the untrusted-snapshot surface: random bit
+    flips, truncations and splices must either refuse (False, receiver
+    bit-untouched) or succeed with a self-consistent window — never
+    raise, never commit partial state."""
+    import numpy as np
+
+    sim = _pruned_donor()
+    donor = sim.processes[0]
+    blob = bytearray(checkpoint.snapshot_bytes(donor))
+    rng = np.random.default_rng(17)
+    for trial in range(60):
+        mutated = bytearray(blob)
+        mode = trial % 4
+        if mode == 0:  # random bit flips
+            for _ in range(int(rng.integers(1, 8))):
+                i = int(rng.integers(0, len(mutated)))
+                mutated[i] ^= 1 << int(rng.integers(0, 8))
+        elif mode == 1:  # truncation
+            mutated = mutated[: int(rng.integers(0, len(mutated)))]
+        elif mode == 2:  # splice a random chunk
+            i = int(rng.integers(0, len(mutated)))
+            mutated[i:i] = bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+        else:  # duplicate a tail chunk
+            i = int(rng.integers(0, len(mutated)))
+            mutated = mutated + mutated[i:]
+        fresh = Process(GC, 0, InMemoryTransport())
+        ok = checkpoint.restore_from_snapshot(fresh, bytes(mutated))
+        if not ok:
+            assert fresh.dag.base_round == 0 and fresh.round == 0
+            assert len(fresh.dag.vertices) == GC.n  # genesis only
+        else:
+            # accepted: the window must be internally consistent
+            assert fresh.dag.max_round - fresh.dag.base_round >= GC.gc_depth
+            for v in fresh.dag.vertices.values():
+                assert v.round >= fresh.dag.base_round
+            fresh._started = True
+            fresh.step()  # and the machine must still run
+
+
+def test_snapshot_valid_json_wrong_shape_refused():
+    """Valid-JSON-but-not-a-dict headers must take the False path, not
+    raise (round-4 review; the bitflip fuzz can't produce these)."""
+    for head in (b"[]", b"42", b'"x"', b"null"):
+        blob = struct.pack("<I", len(head)) + head
+        fresh = Process(GC, 0, InMemoryTransport())
+        assert not checkpoint.restore_from_snapshot(fresh, blob)
+        assert fresh.round == 0
