@@ -121,6 +121,18 @@ def _signed_round(signers, n: int, rnd: int, quorum: int):
     return vs
 
 
+def _sign_rounds_worker(args):
+    """Sign a slice of rounds in a spawn worker (pure-Python Ed25519 —
+    no jax import, so workers start fast and are fork-safety-clean).
+    Deterministic: output depends only on (seeds, n, round numbers)."""
+    seeds, n, rnds = args
+    from dag_rider_tpu.verifier.base import VertexSigner
+
+    signers = [VertexSigner(s) for s in seeds]
+    quorum = _quorum(n)
+    return [(r, _signed_round(signers, n, r, quorum)) for r in rnds]
+
+
 def _build_batches(n: int, rounds: int):
     from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
     from dag_rider_tpu.verifier.tpu import TPUVerifier
@@ -128,9 +140,44 @@ def _build_batches(n: int, rounds: int):
     reg, seeds = KeyRegistry.generate(n)
     signers = [VertexSigner(s) for s in seeds]
     quorum = _quorum(n)
-    batches = [
-        _signed_round(signers, n, r + 1, quorum) for r in range(rounds)
-    ]
+    workers = min(8, os.cpu_count() or 1)
+    if n * rounds >= 2048 and workers >= 4:
+        # The n=256 headline phase signs ~16k vertices at ~2.6 ms each —
+        # 42 s of the cold-start budget single-threaded (round-3 weak
+        # #8). Host signing is embarrassingly parallel and deterministic;
+        # spawn (not fork: the parent may hold live TPU-backend state)
+        # + jax-free workers cut it to ~1/workers. Signature memos ride
+        # the pickles, so digest() stays pre-warmed like the serial path.
+        import concurrent.futures as cf
+        import multiprocessing as mp
+
+        chunks = [
+            list(range(w + 1, rounds + 1, workers)) for w in range(workers)
+        ]
+        by_round = {}
+        try:
+            with cf.ProcessPoolExecutor(
+                max_workers=workers, mp_context=mp.get_context("spawn")
+            ) as ex:
+                for part in ex.map(
+                    _sign_rounds_worker,
+                    [(seeds, n, c) for c in chunks if c],
+                ):
+                    for r, vs in part:
+                        by_round[r] = vs
+            batches = [by_round[r + 1] for r in range(rounds)]
+        except Exception as e:  # noqa: BLE001 — a broken pool must not
+            # cost the headline phase; serial signing is the pre-change
+            # behavior and always works
+            _mark(f"parallel signing failed ({e!r}); falling back to serial")
+            batches = [
+                _signed_round(signers, n, r + 1, quorum)
+                for r in range(rounds)
+            ]
+    else:
+        batches = [
+            _signed_round(signers, n, r + 1, quorum) for r in range(rounds)
+        ]
     return TPUVerifier(reg), batches, signers
 
 
@@ -779,21 +826,37 @@ def _run_stage(stage: str, env: dict, timeout_s: float):
     """Run a stage subprocess; return (last_json | None, stderr_tail)."""
     env = dict(env)
     env["DAGRIDER_BENCH_STAGE"] = stage
+    # Own process group + group kill on timeout: the measure child may
+    # hold a spawn pool of signing workers, and SIGKILLing only the
+    # child would orphan them to contend with the CPU fallback.
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)],
+        env=env,
+        cwd=_REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-u", os.path.abspath(__file__)],
-            env=env,
-            cwd=_REPO,
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        out, err = proc.stdout or "", proc.stderr or ""
+        out, err = proc.communicate(timeout=timeout_s)
         rc = proc.returncode
     except subprocess.TimeoutExpired as e:
-        out = e.output if isinstance(e.output, str) else (e.output or b"").decode("utf-8", "replace")
-        err = e.stderr if isinstance(e.stderr, str) else (e.stderr or b"").decode("utf-8", "replace")
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, err = proc.communicate()
         rc = "timeout"
+        out = (e.output or "") + (out or "") if isinstance(
+            e.output, str
+        ) else out or ""
+        err = (e.stderr or "") + (err or "") if isinstance(
+            e.stderr, str
+        ) else err or ""
+    out, err = out or "", err or ""
     parsed = None
     for line in reversed((out or "").splitlines()):
         line = line.strip()
