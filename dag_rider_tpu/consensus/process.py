@@ -860,6 +860,13 @@ class Process:
         self._seen_digests = {
             k: d for k, d in self._seen_digests.items() if k.round >= base
         }
+        # A reliable-broadcast stage keeps per-slot vote books — retire
+        # them along the same floor (transport/rbc.py prune_below), or a
+        # long-running RBC node leaks exactly the state class the DAG
+        # prune just bounded.
+        tp_prune = getattr(self.transport, "prune_below", None)
+        if tp_prune is not None:
+            tp_prune(base)
         self.metrics.inc("vertices_pruned", removed)
         self.log.event("pruned", floor=base, removed=removed)
         return removed

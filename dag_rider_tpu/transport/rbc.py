@@ -68,6 +68,49 @@ class RbcTransport(Transport):
         self._ready_refresh_at: Dict[Slot, float] = {}
         self._echoes: Dict[Tuple[Slot, bytes], Set[int]] = {}
         self._readies: Dict[Tuple[Slot, bytes], Set[int]] = {}
+        #: slots below this round are retired (see prune_below): their
+        #: state is dropped and new frames for them are discarded, so a
+        #: replayed VAL cannot regrow the books.
+        self.floor = 0
+
+    def prune_below(self, floor: int) -> int:
+        """Retire per-slot Bracha state for rounds below ``floor``.
+
+        The owning Process calls this with its GC floor
+        (Process.maybe_prune; checkpoint/snapshot restore re-establishes
+        it): below the floor, vertices are excluded from delivery at
+        every correct process and sync windows are refused, so
+        echo/ready bookkeeping for those slots is dead weight — the same
+        unbounded-growth class DagState.prune_below retires. The floor
+        also gates _on_inner: frames for retired slots are dropped, not
+        re-admitted into fresh state.
+
+        Liveness across DIVERGING floors (peers prune at different
+        times, so a pruned peer can no longer refresh READY for a
+        laggard's catch-up slot): with at most f peers pruned past a
+        slot, 2f of the remaining peers' READYs reach the laggard, whose
+        own amplification (f+1 READYs -> READY) completes the 2f+1
+        quorum; once f+1 peers have pruned past it, those same peers
+        nack the laggard's sync window and the f+1-nack quorum routes it
+        to peer state transfer instead (Process._on_sync_nack) — the
+        boundary is exact, no wedge gap. Returns entries removed."""
+        if floor <= self.floor:
+            return 0
+        self.floor = floor
+        removed = 0
+        for d in (self._val, self._decided, self._serves, self._ready_refresh_at):
+            for k in [k for k in d if k[0] < floor]:
+                del d[k]
+                removed += 1
+        for s in (self._echoed, self._readied, self._delivered):
+            for k in [k for k in s if k[0] < floor]:
+                s.discard(k)
+                removed += 1
+        for book in (self._echoes, self._readies):
+            for k in [k for k in book if k[0][0] < floor]:
+                del book[k]
+                removed += 1
+        return removed
 
     # -- Transport interface ------------------------------------------------
 
@@ -93,6 +136,12 @@ class RbcTransport(Transport):
     # -- protocol -----------------------------------------------------------
 
     def _on_inner(self, msg: BroadcastMessage) -> None:
+        if (
+            self.floor
+            and msg.kind in ("val", "echo", "ready", "fetch")
+            and msg.round < self.floor
+        ):
+            return  # retired slot (see prune_below): drop, don't regrow
         if msg.kind == "val" and msg.vertex is not None:
             self._on_val(msg)
         elif msg.kind == "echo":

@@ -163,6 +163,12 @@ def restore(process, path: str) -> None:
         log.append(VertexID(r, s))
     process.delivered_log = log
     process._rebuild_delivered_mask()
+    # A reliable-broadcast stage's slot floor must follow the restored
+    # window, or replayed frames for retired rounds regrow its books
+    # until the next wave decision prunes (round-4 review).
+    tp_prune = getattr(process.transport, "prune_below", None)
+    if tp_prune is not None:
+        tp_prune(process.dag.base_round)
     process.blocks_to_propose.clear()
     for txs in manifest["blocks_to_propose"]:
         process.blocks_to_propose.append(
@@ -370,6 +376,9 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     process._rebuild_delivered_mask()
     process.state_transfer_needed = False
     process._horizon_nacks.clear()
+    tp_prune = getattr(process.transport, "prune_below", None)
+    if tp_prune is not None:
+        tp_prune(base)
     inserted = len(accepted)
     process.metrics.inc("state_transfers")
     process.log.event(

@@ -230,3 +230,77 @@ def test_restore_rejects_corrupt_delivered_log(tmp_path):
         fresh = Process(GC, 0, InMemoryTransport())
         with pytest.raises(ValueError, match="corrupt checkpoint"):
             checkpoint.restore(fresh, str(tmp_path))
+
+
+def test_rbc_vote_books_pruned_with_dag():
+    """The Bracha stage's per-slot state must follow the Process's GC
+    floor (round-4: without this, RBC nodes leaked exactly the state
+    class DagState.prune_below bounds), and frames for retired slots
+    must be dropped, not re-admitted into fresh books."""
+    sim = Simulation(GC, rbc=True)
+    sim.submit_blocks(per_process=2)
+    _run_rounds(sim, 90)
+    sim.check_agreement()
+    p = sim.processes[0]
+    rbc = p.transport
+    assert p.dag.base_round > 0
+    assert rbc.floor == p.dag.base_round
+    # every slot map is windowed to the live rounds
+    for d in (rbc._val, rbc._decided):
+        assert all(k[0] >= rbc.floor for k in d)
+    for s in (rbc._echoed, rbc._readied, rbc._delivered):
+        assert all(k[0] >= rbc.floor for k in s)
+    for book in (rbc._echoes, rbc._readies):
+        assert all(k[0][0] >= rbc.floor for k in book)
+    live_slots = len(rbc._delivered)
+    assert live_slots <= GC.n * (GC.gc_depth + 4 * GC.wave_length)
+
+    # a replayed VAL for a retired slot is dropped silently
+    old = BroadcastMessage(
+        vertex=Vertex(
+            id=VertexID(1, 1),
+            strong_edges=tuple(VertexID(0, s) for s in range(GC.quorum)),
+        ),
+        round=1,
+        sender=1,
+    )
+    before = len(rbc._val)
+    rbc._on_inner(old)
+    assert len(rbc._val) == before
+    assert (1, 1) not in rbc._echoed
+
+
+def test_rbc_floor_follows_restores():
+    """checkpoint restore and snapshot transfer must re-establish the
+    RBC slot floor, or replayed frames for retired rounds regrow the
+    vote books until the next wave decision (round-4 review)."""
+    from dag_rider_tpu.transport.rbc import RbcTransport
+
+    sim = Simulation(GC, rbc=True)
+    sim.submit_blocks(per_process=2)
+    _run_rounds(sim, 80)
+    donor = sim.processes[0]
+    assert donor.dag.base_round > 0
+    blob = checkpoint.snapshot_bytes(donor)
+
+    broker = InMemoryTransport()
+    rbc = RbcTransport(broker, 0, GC.n, GC.f)
+    fresh = Process(GC, 0, rbc)
+    assert checkpoint.restore_from_snapshot(fresh, blob)
+    assert rbc.floor == fresh.dag.base_round > 0
+
+
+def test_rbc_floor_follows_checkpoint_restore(tmp_path):
+    from dag_rider_tpu.transport.rbc import RbcTransport
+
+    sim = Simulation(GC, rbc=True)
+    sim.submit_blocks(per_process=2)
+    _run_rounds(sim, 80)
+    donor = sim.processes[0]
+    checkpoint.save(donor, str(tmp_path))
+
+    broker = InMemoryTransport()
+    rbc = RbcTransport(broker, 0, GC.n, GC.f)
+    fresh = Process(GC, 0, rbc)
+    checkpoint.restore(fresh, str(tmp_path))
+    assert rbc.floor == fresh.dag.base_round > 0
