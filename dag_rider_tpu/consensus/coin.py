@@ -47,6 +47,12 @@ class CommonCoin(abc.ABC):
     def observe_share(self, wave: int, source: int, share: bytes) -> None:
         """Ingest another process's share. No-op for share-less coins."""
 
+    def prune_below(self, wave: int) -> None:
+        """Drop per-wave state below ``wave`` (the GC floor's wave) —
+        no-op for stateless coins. Called by Process.maybe_prune so the
+        coin's books follow the same bounded window as the DAG and the
+        RBC stage."""
+
 
 class FixedCoin(CommonCoin):
     """Constant leader — reference-stub semantics (``process.go:390-392``),
@@ -135,6 +141,14 @@ class ThresholdCoin(CommonCoin):
             sigma = self._th.aggregate(good, self.keys.threshold, msm=self._msm)
             if sigma is not None:
                 self._sigma[wave] = sigma
+
+    def prune_below(self, wave: int) -> None:
+        """Retire share/sigma/attempt books for waves below ``wave``.
+        Safe: the retro leader chain only walks waves above the decided
+        cursor, and the GC floor sits gc_depth rounds below it."""
+        for d in (self._shares, self._sigma, self._tried_at):
+            for w in [w for w in d if w < wave]:
+                del d[w]
 
     def ready(self, wave: int) -> bool:
         self._try_aggregate(wave)

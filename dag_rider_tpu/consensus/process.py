@@ -207,6 +207,14 @@ class Process:
                 "reject_stamp", round=msg.round, sender=msg.sender
             )
             return
+        if v.id.round <= self.dag.base_round:
+            # At/below the GC floor: retired everywhere, unadmittable
+            # here — drop BEFORE digest/verify/coin-share observation, or
+            # replayed old VALs would re-feed the books the prune just
+            # retired and burn verify work (round-4 review; the RBC
+            # stage's floor gate covers only RBC deployments).
+            self.metrics.inc("msgs_below_gc_horizon")
+            return
         if (
             self.dag.present(v.id)
             or v.id in self._buffered_ids
@@ -867,6 +875,9 @@ class Process:
         tp_prune = getattr(self.transport, "prune_below", None)
         if tp_prune is not None:
             tp_prune(base)
+        # ... and the coin's per-wave share books (same floor, in waves)
+        if base >= 1:
+            self.coin.prune_below(self.cfg.wave_of_round(base))
         self.metrics.inc("vertices_pruned", removed)
         self.log.event("pruned", floor=base, removed=removed)
         return removed

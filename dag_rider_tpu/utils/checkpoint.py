@@ -379,6 +379,10 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     tp_prune = getattr(process.transport, "prune_below", None)
     if tp_prune is not None:
         tp_prune(base)
+    if base >= 1:
+        # a live laggard's pre-transfer share books are below the new
+        # floor too (same class as the RBC books two lines up)
+        process.coin.prune_below(process.cfg.wave_of_round(base))
     inserted = len(accepted)
     process.metrics.inc("state_transfers")
     process.log.event(

@@ -304,3 +304,35 @@ def test_rbc_floor_follows_checkpoint_restore(tmp_path):
     fresh = Process(GC, 0, rbc)
     checkpoint.restore(fresh, str(tmp_path))
     assert rbc.floor == fresh.dag.base_round > 0
+
+
+def test_threshold_coin_books_pruned_with_dag():
+    """The coin's per-wave share/sigma books follow the GC floor — the
+    last unbounded-state holdout after DAG + RBC pruning."""
+    from dag_rider_tpu.consensus.coin import ThresholdCoin
+    from dag_rider_tpu.crypto import threshold as th
+
+    n, f = 4, 1
+    keys = th.ThresholdKeys.generate(n, f + 1)
+    oracle = ThresholdCoin(keys, 0, n)
+
+    def coin_factory(i):
+        c = ThresholdCoin(keys, i, n)
+        c._shares = oracle._shares
+        c._sigma = oracle._sigma
+        c._tried_at = oracle._tried_at
+        return c
+
+    cfg = Config(
+        n=n, coin="threshold_bls", propose_empty=True, gc_depth=16
+    )
+    sim = Simulation(cfg, coin_factory=coin_factory)
+    sim.submit_blocks(per_process=2)
+    _run_rounds(sim, 60)
+    sim.check_agreement()
+    p = sim.processes[0]
+    assert p.dag.base_round > 4
+    floor_wave = cfg.wave_of_round(p.dag.base_round)
+    assert oracle._sigma, "coin actually decided waves"
+    assert all(w >= floor_wave for w in oracle._shares)
+    assert all(w >= floor_wave for w in oracle._sigma)
