@@ -226,6 +226,11 @@ def _sim_rung(
             c._shares = oracle._shares
             c._sigma = oracle._sigma
             c._tried_at = oracle._tried_at
+            # shared books must not be pruned by whichever process's GC
+            # floor runs first — a (slightly) lagging sibling still reads
+            # them; a production per-process coin prunes by its OWN
+            # floor, which cannot outrun its own queries
+            c.prune_below = lambda wave: None
             return c
 
         cfg = Config(

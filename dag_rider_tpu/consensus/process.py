@@ -790,6 +790,18 @@ class Process:
         leaders.push(leader)
         cur = leader
         for w in range(wave - 1, self.decided_wave, -1):
+            if (
+                self.cfg.wave_round(w, 1) <= self.dag.base_round
+                and not self.coin.ready(w)
+            ):
+                # The coin shares for w live below our GC window (after
+                # a prune or state transfer), so the leader is
+                # unknowable here — and every delivery this chain link
+                # could produce sits at rounds <= r1(w) <= base, all
+                # floor-excluded at this process. Skipping the link
+                # keeps the total order identical to processes that do
+                # walk it.
+                continue
             prior = self._wave_leader(w)
             if prior is not None and self.dag.path(
                 cur.id, prior.id, strong_only=True
@@ -878,6 +890,14 @@ class Process:
         # ... and the coin's per-wave share books (same floor, in waves)
         if base >= 1:
             self.coin.prune_below(self.cfg.wave_of_round(base))
+        # Pending waves whose shares just got pruned can never become
+        # ready — and their deliveries are floor-excluded here anyway;
+        # without this they would be re-polled every step forever.
+        self._pending_waves = {
+            w
+            for w in self._pending_waves
+            if self.cfg.wave_round(w, 1) > base
+        }
         self.metrics.inc("vertices_pruned", removed)
         self.log.event("pruned", floor=base, removed=removed)
         return removed

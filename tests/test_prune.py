@@ -336,3 +336,57 @@ def test_threshold_coin_books_pruned_with_dag():
     assert oracle._sigma, "coin actually decided waves"
     assert all(w >= floor_wave for w in oracle._shares)
     assert all(w >= floor_wave for w in oracle._sigma)
+
+
+def test_retro_chain_survives_pruned_coin_after_state_transfer():
+    """After a state transfer, decided_wave resets to 0 while the coin
+    books below the imported floor are pruned — the first wave commit's
+    retroactive walk must skip those unknowable links (their deliveries
+    are floor-excluded here) instead of raising 'coin not ready'
+    (round-4 review)."""
+    from dag_rider_tpu.consensus.coin import ThresholdCoin
+    from dag_rider_tpu.crypto import threshold as th
+
+    n = 4
+    keys = th.ThresholdKeys.generate(n, 2)
+    oracle = ThresholdCoin(keys, 0, n)
+
+    def cf(i):
+        c = ThresholdCoin(keys, i, n)
+        c._shares = oracle._shares
+        c._sigma = oracle._sigma
+        c._tried_at = oracle._tried_at
+        return c
+
+    cfg = Config(n=n, coin="threshold_bls", propose_empty=True, gc_depth=16)
+    sim = Simulation(cfg, coin_factory=cf)
+    sim.submit_blocks(per_process=2)
+    _run_rounds(sim, 60)
+    donor = sim.processes[0]
+    assert donor.dag.base_round > 4
+    blob = checkpoint.snapshot_bytes(donor)
+
+    fresh = Process(cfg, 0, InMemoryTransport(), coin=cf(0))
+    assert checkpoint.restore_from_snapshot(fresh, blob)
+    assert fresh.decided_wave == 0
+    # waves below the imported floor have no books anymore
+    floor_wave = cfg.wave_of_round(fresh.dag.base_round)
+    assert all(w >= floor_wave for w in fresh.coin._shares)
+    # the next wave commit walks the retro chain back to decided_wave=0
+    # straight through the pruned-coin waves — it must skip them, not
+    # raise "coin for wave w not ready"
+    committed = False
+    for w in range(cfg.wave_of_round(fresh.dag.max_round), 0, -1):
+        if cfg.wave_round(w, cfg.wave_length) > fresh.dag.max_round:
+            continue  # wave not fully inside the imported window
+        if cfg.wave_round(w, 1) <= fresh.dag.base_round:
+            break  # below the floor: nothing left to try
+        fresh._try_wave(w)
+        if fresh.decided_wave == w:
+            committed = True
+            break
+    assert committed, "restored node could not commit any window wave"
+    assert not any(
+        cfg.wave_round(w, 1) <= fresh.dag.base_round
+        for w in fresh._pending_waves
+    )
