@@ -248,7 +248,7 @@ def _sim_rung(
     while _t.monotonic() - t0 < box_s:
         pumped += sim.run(max_messages=chunk)
     dt = _t.monotonic() - t0
-    sigs = sum(sum(p.metrics.verify_batch_sizes) for p in sim.processes)
+    sigs = sum(p.metrics.verify_sigs_total for p in sim.processes)
     waves = [
         s for p in sim.processes for s in p.metrics.wave_commit_seconds
     ]

@@ -790,18 +790,27 @@ class Process:
         leaders.push(leader)
         cur = leader
         for w in range(wave - 1, self.decided_wave, -1):
-            if (
-                self.cfg.wave_round(w, 1) <= self.dag.base_round
-                and not self.coin.ready(w)
-            ):
-                # The coin shares for w live below our GC window (after
-                # a prune or state transfer), so the leader is
-                # unknowable here — and every delivery this chain link
-                # could produce sits at rounds <= r1(w) <= base, all
-                # floor-excluded at this process. Skipping the link
-                # keeps the total order identical to processes that do
-                # walk it.
-                continue
+            if not self.coin.ready(w):
+                if self.cfg.wave_round(w, 1) <= self.dag.base_round:
+                    # The coin shares for w live below our GC window
+                    # (after a prune or state transfer), so the leader
+                    # is unknowable here — and every delivery this
+                    # chain link could produce sits at rounds <=
+                    # r1(w) <= base, all floor-excluded at this
+                    # process. Skipping the link keeps the total order
+                    # identical to processes that do walk it.
+                    continue
+                # An IN-WINDOW link whose shares are still in flight:
+                # skipping would diverge the total order (other
+                # processes may commit this leader), so defer the WHOLE
+                # commit and let _retry_pending_waves re-enter once the
+                # shares land — decided_wave is untouched, so the
+                # re-entry redoes the full walk.
+                self._pending_waves.add(wave)
+                self.log.event(
+                    "wave_pending_chain_coin", wave=wave, link=w
+                )
+                return
             prior = self._wave_leader(w)
             if prior is not None and self.dag.path(
                 cur.id, prior.id, strong_only=True

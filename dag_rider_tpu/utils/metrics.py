@@ -3,23 +3,37 @@
 The reference has none (SURVEY.md §5: logging only, 3 Debug call sites).
 These counters feed the BASELINE.json metric surface: rounds advanced,
 waves decided/skipped, vertices delivered, verify-batch latency.
+
+Sample lists are bounded (deque windows): a long-running node must not
+leak a float per verify batch / wave commit for its lifetime — the same
+bounded-state rule the DAG/RBC/coin GC enforces (round 4). Totals that
+consumers sum (verify sig counts, cumulative verify seconds) are kept as
+running counters instead, so throughput math is exact over the whole run
+while percentiles window to the recent samples.
 """
 
 from __future__ import annotations
 
 import time
-from collections import defaultdict
-from typing import Dict, List
+from collections import defaultdict, deque
+from typing import Deque, Dict
+
+#: per-series sample-window size: big enough that bench boxes (minutes)
+#: keep every sample, small enough to bound week-long nodes
+SAMPLE_WINDOW = 65536
 
 
 class Metrics:
-    """Per-process counters + verify-latency samples."""
+    """Per-process counters + windowed latency samples."""
 
     def __init__(self) -> None:
         self.counters: Dict[str, int] = defaultdict(int)
-        self.verify_batch_seconds: List[float] = []
-        self.verify_batch_sizes: List[int] = []
-        self.wave_commit_seconds: List[float] = []
+        self.verify_batch_seconds: Deque[float] = deque(maxlen=SAMPLE_WINDOW)
+        self.verify_batch_sizes: Deque[int] = deque(maxlen=SAMPLE_WINDOW)
+        self.wave_commit_seconds: Deque[float] = deque(maxlen=SAMPLE_WINDOW)
+        #: exact running totals (never windowed) — the sums consumers use
+        self.verify_sigs_total = 0
+        self.verify_seconds_total = 0.0
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -27,6 +41,8 @@ class Metrics:
     def observe_verify_batch(self, size: int, seconds: float) -> None:
         self.verify_batch_sizes.append(size)
         self.verify_batch_seconds.append(seconds)
+        self.verify_sigs_total += size
+        self.verify_seconds_total += seconds
 
     def observe_wave_commit(self, seconds: float) -> None:
         """Duration of one decided wave's commit + total-order pass (the
@@ -34,19 +50,19 @@ class Metrics:
         self.wave_commit_seconds.append(seconds)
 
     @staticmethod
-    def _p50(samples: List[float]) -> float:
+    def _p50(samples) -> float:
         s = sorted(samples)
         return s[len(s) // 2]
 
     def sigs_per_sec(self) -> float:
-        total_t = sum(self.verify_batch_seconds)
-        if total_t == 0:
+        if self.verify_seconds_total == 0:
             return 0.0
-        return sum(self.verify_batch_sizes) / total_t
+        return self.verify_sigs_total / self.verify_seconds_total
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = dict(self.counters)
         if self.verify_batch_sizes:
+            out["verify_sigs_total"] = self.verify_sigs_total
             out["verify_sigs_per_sec"] = self.sigs_per_sec()
             out["verify_batch_p50_ms"] = 1e3 * self._p50(self.verify_batch_seconds)
             out["verify_batch_mean_size"] = sum(self.verify_batch_sizes) / len(

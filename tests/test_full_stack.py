@@ -92,7 +92,7 @@ def test_full_stack_agreement_liveness_equivocation(coin_keys):
 
     # --- the device verifier was in the loop for every admission
     total_verified = sum(
-        sum(p.metrics.verify_batch_sizes) for p in sim.processes
+        p.metrics.verify_sigs_total for p in sim.processes
     )
     assert total_verified > 0
     # every admitted remote vertex passed through a verify batch

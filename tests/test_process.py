@@ -333,3 +333,37 @@ def test_weak_edges_partial_frontier_matches_oracle():
     assert p._weak_edges_for(rnd, strong) == _brute_weak_edges(
         p, rnd, strong
     )
+
+
+def test_retro_chain_defers_on_unready_in_window_link():
+    """An IN-WINDOW chain link whose coin shares are still in flight must
+    defer the whole commit (skipping would diverge the total order;
+    raising crashed the process pre-round-4) and complete once ready."""
+    from dag_rider_tpu.consensus.coin import CommonCoin
+
+    class FlakyCoin(CommonCoin):
+        def __init__(self, n, slow):
+            self.n, self.slow, self.released = n, slow, False
+
+        def ready(self, wave):
+            return self.released or wave != self.slow
+
+        def choose_leader(self, wave):
+            if not self.ready(wave):
+                raise RuntimeError(f"coin for wave {wave} not ready")
+            return wave % self.n
+
+    cfg = Config(n=4, coin="round_robin", propose_empty=True)
+    coin = FlakyCoin(4, slow=1)
+    p = Process(cfg, 0, InMemoryTransport(), coin=coin)
+    for r in range(1, 9):
+        prev = tuple(VertexID(r - 1, s) for s in range(4))
+        for s in range(4):
+            p.dag.insert(Vertex(id=VertexID(r, s), strong_edges=prev))
+    p.round = 8
+    p._try_wave(2)  # chain must walk to wave 1, whose coin is not ready
+    assert p.decided_wave == 0 and 2 in p._pending_waves
+    coin.released = True
+    p._retry_pending_waves()
+    assert p.decided_wave == 2
+    assert len(p.delivered_log) > 0
