@@ -390,3 +390,28 @@ def test_retro_chain_survives_pruned_coin_after_state_transfer():
         cfg.wave_round(w, 1) <= fresh.dag.base_round
         for w in fresh._pending_waves
     )
+
+
+@pytest.mark.parametrize("seed", [2, 19, 101, 977])
+def test_gc_agreement_under_random_interleavings(seed):
+    """Adversarial delivery order WITH pruning active: the interaction
+    zone of stragglers, retro chains, floor exclusion and the blocked
+    memo. Any interleaving must preserve total-order agreement and the
+    bounded window — exactly where a GC determinism bug would surface."""
+    from dag_rider_tpu.consensus import RandomizedScheduler
+
+    sim = Simulation(GC)
+    sim.submit_blocks(per_process=2)
+    for p in sim.processes:
+        p.start()
+    sched = RandomizedScheduler(sim.transport, seed)
+    for _ in range(400):
+        if not sched.run(max_messages=200):
+            break
+        for p in sim.processes:
+            p.step()
+    sim.check_agreement()
+    assert any(p.dag.base_round > 0 for p in sim.processes), "never pruned"
+    for p in sim.processes:
+        window = p.dag.max_round - p.dag.base_round + 1
+        assert len(p.dag.vertices) <= GC.n * (window + 1)
