@@ -111,3 +111,22 @@ def test_verify_precomputed_matches_full():
     )
     assert ed25519.verify_precomputed(pub, k, sig)
     assert not ed25519.verify_precomputed(pub, (k + 1) % ed25519.L, sig)
+
+
+def test_fixed_base_comb_matches_ladder():
+    """scalar_mult_base (the signing hot path's comb) is the same group
+    element as the double-and-add ladder for edge and random scalars —
+    including scalars at/above L, 2^255-1, and the >=2^256 ladder
+    fallback."""
+    import random
+
+    rng = random.Random(5)
+    cases = [0, 1, 2, 15, 16, ed25519.L - 1, ed25519.L, ed25519.L + 7,
+             2**255 - 1, 2**256, 2**256 + 3] + [
+        rng.randrange(0, 2**256) for _ in range(40)
+    ]
+    for s in cases:
+        want = ed25519.scalar_mult(s, ed25519.B)
+        got = ed25519.scalar_mult_base(s)
+        assert ed25519.point_equal(got, want), s
+        assert ed25519.point_compress(got) == ed25519.point_compress(want), s
