@@ -192,3 +192,22 @@ def test_batch_verify_shares_all_bad():
         i: th.sign_share(keys.share_sks[i], 99) for i in range(3)
     }  # all for the wrong wave
     assert th.batch_verify_shares(keys.share_pks, 1, shares) == {}
+
+
+def test_generator_comb_matches_ladder():
+    """g1_mul/g2_mul fixed-base comb (round-4 host speedup) is the same
+    group element as the Jacobian ladder, edge scalars included."""
+    import random
+
+    from dag_rider_tpu.crypto import bls12381 as bls
+
+    rng = random.Random(3)
+    cases = [0, 1, 2, 15, 16, bls.R - 1, bls.R, bls.R + 5] + [
+        rng.randrange(0, 2**256) for _ in range(20)
+    ]
+    for k in cases:
+        assert bls.g1_mul(k) == bls._ec_mul(bls._FP_OPS, k, bls.G1_GEN), k
+        assert bls.g2_mul(k) == bls._ec_mul(bls._FP2_OPS, k, bls.G2_GEN), k
+    # non-generator bases keep the ladder path and stay correct
+    p = bls.g1_mul(12345)
+    assert bls.g1_mul(7, p) == bls._ec_mul(bls._FP_OPS, 7, p)
