@@ -286,7 +286,11 @@ class GrpcTransport(Transport):
             # until a later broadcast covers it — but every failure is
             # counted and retried with backoff before giving up.
             fut = self._stub(peer).future(payload, timeout=self._rpc_timeout_s)
-        except grpc.RpcError:
+        except (grpc.RpcError, ValueError):
+            # ValueError: update_peer closed the cached channel between
+            # _stub() and .future() — same remedy as an RPC error (the
+            # retry re-resolves through _stub, which builds the new
+            # channel)
             self._on_failure(peer, payload, attempt)
             return
         with self._lock:
@@ -386,7 +390,10 @@ class GrpcTransport(Transport):
         try:
             self._stub(peer)  # ensures the peer channel exists (locked)
             with self._lock:
-                chan = self._channels[peer]
+                chan = self._channels.get(peer)
+            if chan is None:  # update_peer raced the fetch: treat as fail
+                self._inc("net_snapshot_errors")
+                return None
             call = chan.unary_unary(
                 _SNAPSHOT_METHOD,
                 request_serializer=_identity,
@@ -397,6 +404,24 @@ class GrpcTransport(Transport):
             self._inc("net_snapshot_errors")
             return None
         return bytes(blob) if blob else None
+
+    def update_peer(self, peer: int, addr: str) -> None:
+        """Repoint a peer to a new address, dropping the cached channel.
+
+        Deployments normally use STABLE addresses (the node config's
+        peer table), where a restarted peer reappears on the same
+        host:port and the existing channel reconnects by itself. This
+        exists for the dynamic case (ephemeral ports, rescheduled pods):
+        without it, the cached stub keeps sending into the dead old
+        address forever while the peer table lies about the new one.
+        """
+        with self._lock:
+            self._peers[peer] = addr
+            chan = self._channels.pop(peer, None)
+            self._stubs.pop(peer, None)
+            self._consec_fail.pop(peer, None)
+        if chan is not None:
+            chan.close()
 
     def peer_status(self) -> Dict[int, str]:
         """Failure-detector view: peer -> "up" | "down" (down = at least

@@ -459,3 +459,40 @@ def test_forged_ready_quorum_over_grpc_does_not_deliver():
     finally:
         for t in nets:
             t.close()
+
+
+def test_update_peer_repoints_stale_channel():
+    """A peer that restarts on a NEW address is unreachable through the
+    cached gRPC channel until update_peer drops it (round-4 soak
+    finding; stable-address deployments reconnect automatically)."""
+    import time
+
+    a = GrpcTransport(0, "127.0.0.1:0", {})
+    b1 = GrpcTransport(1, "127.0.0.1:0", {})
+    a._peers.update({1: f"127.0.0.1:{b1.bound_port}"})
+    b1._peers.update({0: f"127.0.0.1:{a.bound_port}"})
+    got = []
+    b1.subscribe(1, got.append)
+    v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+    a.broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+    deadline = time.time() + 5
+    while time.time() < deadline and not got:
+        b1.pump(8)
+        time.sleep(0.01)
+    assert got, "baseline delivery failed"
+    b1.close()
+
+    # peer 1 restarts on a different port
+    b2 = GrpcTransport(1, "127.0.0.1:0", {})
+    b2._peers.update({0: f"127.0.0.1:{a.bound_port}"})
+    got2 = []
+    b2.subscribe(1, got2.append)
+    a.update_peer(1, f"127.0.0.1:{b2.bound_port}")
+    a.broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+    deadline = time.time() + 5
+    while time.time() < deadline and not got2:
+        b2.pump(8)
+        time.sleep(0.01)
+    assert got2, "delivery after update_peer failed"
+    a.close()
+    b2.close()
