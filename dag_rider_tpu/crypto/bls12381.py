@@ -27,6 +27,7 @@ decisions do (SURVEY.md §7 hard part (b)).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 from typing import List, Optional, Sequence, Tuple
 
@@ -675,6 +676,7 @@ def g1_decompress(data: bytes):
     return (x, y)
 
 
+@functools.lru_cache(maxsize=256)
 def hash_to_g1(msg: bytes, domain: bytes = b"dagrider-coin-v1") -> tuple:
     """Try-and-increment hash onto the r-torsion of E(Fp).
 
@@ -682,6 +684,11 @@ def hash_to_g1(msg: bytes, domain: bytes = b"dagrider-coin-v1") -> tuple:
     x = H(domain || ctr || msg) mod p until x^3 + 4 is square, pick the
     smaller root for determinism, then clear the cofactor by multiplying
     with h1 = (x-1)^2 / 3 ... here simply multiply by the G1 cofactor.
+
+    LRU-cached: a pure ~2.3 ms map, and every share signer / verifier of
+    a wave hashes the SAME wave tag (n redundant computations per wave
+    in a committee; bounded cache — tags are per-wave, 256 covers any
+    live window many times over).
     """
     ctr = 0
     while True:

@@ -400,7 +400,9 @@ class GrpcTransport(Transport):
                 response_deserializer=_identity,
             )
             blob = call(req, timeout=timeout_s)
-        except grpc.RpcError:
+        except (grpc.RpcError, ValueError):
+            # ValueError: update_peer closed the channel mid-fetch — same
+            # contract as an RPC failure (caller tries the next peer)
             self._inc("net_snapshot_errors")
             return None
         return bytes(blob) if blob else None
@@ -419,7 +421,10 @@ class GrpcTransport(Transport):
             self._peers[peer] = addr
             chan = self._channels.pop(peer, None)
             self._stubs.pop(peer, None)
-            self._consec_fail.pop(peer, None)
+            # _consec_fail is deliberately kept: a peer marked down stays
+            # down until a send SUCCEEDS against the new address, so
+            # peer_status honors its contract and net_peer_recovered
+            # fires exactly once on the actual recovery.
         if chan is not None:
             chan.close()
 
