@@ -120,6 +120,29 @@ class Process:
         #: runtime acts on it — Process has no transport-level RPC).
         self._horizon_nacks: Dict[int, int] = {}
         self.state_transfer_needed = False
+        #: round lo of our most recent sync request — nacks are judged
+        #: against the *requested window*, not just our round: a node
+        #: whose round is ahead of peers' floors can still be wedged
+        #: re-requesting pruned straggler rounds forever.
+        self._sync_last_lo: Optional[int] = None
+        #: responder -> highest nacked floor (monotone for honest
+        #: responders; bounded at n entries). The (f+1)-th largest value
+        #: is the highest floor at least one HONEST responder attests —
+        #: rounds at/below it are finalized history nobody will serve.
+        self._window_nacks: Dict[int, int] = {}
+        #: f+1-attested peer GC floor (monotone max). It gates ONLY the
+        #: sync-request targeting (_maybe_request_sync skips blockers
+        #: at/below it — the endless re-request wedge this exists for).
+        #: It deliberately does NOT touch admission: f+1 floors prove
+        #: one honest peer pruned that history, not that every honest
+        #: peer has — a lower-floor peer may still serve it, so
+        #: dropping buffered vertices here could forfeit a recovery
+        #: (and fork our delivered log from peers who did deliver
+        #: them). Kept-but-unrequested vertices cost bounded memory and
+        #: zero traffic; if the gap ever blocks real progress the node
+        #: falls behind until the floors-above-round rule flips
+        #: state_transfer_needed, the designed recovery.
+        self._attested_floor = 0
         self._seen_digests: Dict[VertexID, bytes] = {}
         self.metrics = Metrics()
         self._started = False
@@ -613,12 +636,12 @@ class Process:
         self._stuck_steps = 0
         self._sync_last_request = now
         lo: Optional[int] = None
-        floor = self.dag.base_round
+        # Rounds at/below our GC floor — or the f+1-attested PEER floor —
+        # are unservable everywhere (peers refuse pruned windows) and
+        # unadmittable here; requesting them would loop forever.
+        floor = max(self.dag.base_round, self._attested_floor)
         for v in self.buffer:
             for e in (*v.strong_edges, *v.weak_edges):
-                # rounds at/below our GC floor are unservable everywhere
-                # (peers refuse pruned windows) and unadmittable here —
-                # requesting them would loop forever
                 if e.round > max(0, floor) and not self.dag.present(e):
                     lo = e.round if lo is None else min(lo, e.round)
         if lo is not None:
@@ -643,6 +666,7 @@ class Process:
             # be a perpetual O(n^2) duplicate-traffic loop.
             return
         hi = lo + self.cfg.sync_window - 1
+        self._sync_last_lo = lo
         self.metrics.inc("sync_requested")
         self.log.event("sync_request", lo=lo, hi=hi)
         self.transport.broadcast(
@@ -662,8 +686,15 @@ class Process:
         above our round, anti-entropy can never close the gap —
         ``state_transfer_needed`` flips and the node runtime fetches a
         peer snapshot (utils.checkpoint.restore_from_snapshot). Floors at
-        or below our round are stale/irrelevant and clear that
-        responder's entry (progress may have resumed)."""
+        or below our round are stale/irrelevant for THAT signal and clear
+        that responder's entry (progress may have resumed).
+
+        Separately, floors above the *requested window* (lo) feed the
+        attested-floor quorum even when our round is ahead of them: a
+        node blocked on pruned straggler rounds would otherwise ignore
+        every nack and re-request unservable history forever (its own
+        GC floor may never advance past the blockers, e.g. with
+        gc_depth=None against pruning peers)."""
         if (
             not 0 <= msg.sender < self.cfg.n
             or msg.sender == self.index
@@ -671,6 +702,25 @@ class Process:
         ):
             return
         floor = msg.round
+        if self._sync_last_lo is not None and floor >= self._sync_last_lo:
+            prev = self._window_nacks.get(msg.sender, 0)
+            if floor > prev:
+                self._window_nacks[msg.sender] = floor
+            if len(self._window_nacks) >= self.cfg.f + 1:
+                # Highest floor that f+1 distinct responders (>= 1
+                # honest) attest: the (f+1)-th largest reported value.
+                # Byzantine inflation is clipped to what an honest
+                # responder corroborates.
+                attested = sorted(self._window_nacks.values())[
+                    len(self._window_nacks) - (self.cfg.f + 1)
+                ]
+                if attested > self._attested_floor:
+                    self._attested_floor = attested
+                    self.log.event(
+                        "attested_floor", floor=attested,
+                        responders=len(self._window_nacks),
+                    )
+                    self.metrics.inc("sync_attested_floor_raises")
         if floor > self.round:
             self._horizon_nacks[msg.sender] = floor
             self.metrics.inc("sync_nacks")

@@ -129,6 +129,7 @@ class Node:
             from dag_rider_tpu.transport.auth import FrameAuth
 
             auth = FrameAuth.for_node(bytes.fromhex(master_hex), index, n)
+        snap_fresh = cfg.get("snapshot_freshness_s", 300.0)
         self.net = GrpcTransport(
             index,
             cfg["listen"],
@@ -138,6 +139,16 @@ class Node:
             # serve our live DAG window; it is self-certifying, see
             # utils.checkpoint.restore_from_snapshot.
             snapshot_provider=lambda: checkpoint.snapshot_bytes(self.process),
+            # Donor-side availability knobs: per-relayer serve interval,
+            # and the request-timestamp freshness window (fleets with
+            # known clock skew widen it; null in the JSON config
+            # disables freshness checking entirely).
+            snapshot_min_interval_s=float(
+                cfg.get("snapshot_min_interval_s", 1.0)
+            ),
+            snapshot_freshness_s=(
+                None if snap_fresh is None else float(snap_fresh)
+            ),
         )
         transport = self.net
         if cfg.get("rbc", True):

@@ -20,7 +20,11 @@ protocol actually relies on.
 Keys come from a dealer (``FrameAuth.derive``) — the same trust model the
 threshold-BLS coin already uses (crypto/threshold.py ``ThresholdKeys``):
 ``k_ij = HMAC(master, "pair" || min(i,j) || max(i,j))``, each node holding
-only its own row. Replayed frames verify (the MAC covers content, not
+only its own row. The pair key is symmetric (k_ij == k_ji), so the MAC'd
+data binds the *direction* — ``sender || receiver || payload`` — making the
+primitive self-contained against reflection (bouncing i's frame back at i)
+rather than relying on downstream per-kind sender checks. Replayed frames
+in the same direction still verify (the MAC covers content, not
 freshness); that is safe here because every consumer is idempotent or
 rate-limited: Bracha votes land in per-(slot, digest) *sets*, and sync
 serves are cooldown-throttled (Process._serve_sync).
@@ -34,7 +38,12 @@ import struct
 from typing import Dict, List
 
 TAG_BYTES = 32
-_DOMAIN = b"dagrider-frame-v1"
+# v2: the MAC'd data grew direction bytes (sender || receiver). The domain
+# bump makes the wire incompatibility with v1 binaries explicit and
+# greppable — mixed-version frames fail auth either way (rolling upgrades
+# must replace the whole committee), but a version tag beats a silent
+# net_auth_rejects storm that reads like an attack.
+_DOMAIN = b"dagrider-frame-v2"
 
 
 def _pair_key(master: bytes, i: int, j: int) -> bytes:
@@ -71,16 +80,28 @@ class FrameAuth:
         )
 
     def tag(self, peer: int, payload: bytes) -> bytes:
-        """MAC for a frame this node sends to ``peer``."""
+        """MAC for a frame this node sends to ``peer``.
+
+        The direction (sender=self, receiver=peer) is bound into the MAC'd
+        data so the symmetric pair key cannot be used to reflect a frame
+        back at its author or redirect it to a third party.
+        """
         return hmac.new(
-            self._keys[peer], _DOMAIN + payload, hashlib.sha256
+            self._keys[peer],
+            _DOMAIN + struct.pack("<II", self.index, peer) + payload,
+            hashlib.sha256,
         ).digest()
 
     def check(self, claimed_sender: int, payload: bytes, tag: bytes) -> bool:
-        """Verify a received frame against the claimed sender's pair key.
-        Constant-time compare; unknown senders fail closed."""
+        """Verify a received frame against the claimed sender's pair key,
+        with this node bound as the receiver. Constant-time compare;
+        unknown senders fail closed."""
         key = self._keys.get(claimed_sender)
         if key is None or len(tag) != TAG_BYTES:
             return False
-        want = hmac.new(key, _DOMAIN + payload, hashlib.sha256).digest()
+        want = hmac.new(
+            key,
+            _DOMAIN + struct.pack("<II", claimed_sender, self.index) + payload,
+            hashlib.sha256,
+        ).digest()
         return hmac.compare_digest(want, tag)

@@ -19,8 +19,10 @@ stays single-threaded (SURVEY.md D4's fix) — only the inbox is shared.
 
 from __future__ import annotations
 
+import math
 import struct
 import threading
+import time
 from collections import deque
 from typing import Callable, Deque, Dict, Optional
 
@@ -38,7 +40,7 @@ _SNAPSHOT_METHOD = f"/{_SERVICE}/Snapshot"
 _identity = lambda b: b  # noqa: E731 — bytes in, bytes out
 
 
-_SNAP_DOMAIN = b"dagrider-snapshot-req"
+_SNAP_DOMAIN = b"dagrider-snapshot-req-v2"  # v2: timestamped request body
 
 
 class _DeliverHandler(grpc.GenericRpcHandler):
@@ -48,13 +50,65 @@ class _DeliverHandler(grpc.GenericRpcHandler):
         snapshot_provider: Optional[Callable[[], bytes]] = None,
         auth=None,
         snapshot_min_interval_s: float = 1.0,
+        snapshot_freshness_s: Optional[float] = 300.0,
+        metrics_inc: Optional[Callable[[str], None]] = None,
     ):
         self._sink = sink
         self._snapshot = snapshot_provider
         self._auth = auth
+        self._inc = metrics_inc if metrics_inc is not None else lambda _n: None
+        # <= 0 normalizes to the unthrottled / uncheck-everything intent
+        # (and keeps the token-bucket divisor positive): interval 0 means
+        # "no per-relayer throttle", freshness 0 means "no freshness
+        # check" — NOT "refuse everything", which a literal 0 window
+        # would do (every real ts is >0 seconds old on arrival).
+        if snapshot_min_interval_s <= 0.0:
+            snapshot_min_interval_s = 1e-9
+        if snapshot_freshness_s is not None and snapshot_freshness_s <= 0.0:
+            snapshot_freshness_s = None
         self._snap_lock = threading.Lock()
-        self._snap_last = float("-inf")
+        # Authenticated requesters are throttled PER RELAYER: one
+        # Byzantine committee member hammering Snapshot must not starve
+        # an honest laggard whose state-transfer fetch is its only
+        # recovery path once f+1 peers have pruned past it. The table is
+        # naturally bounded at n entries — only relayers whose MAC
+        # verifies (known pair keys) ever land in it. Unauthenticated
+        # deployments fall back to a stricter GLOBAL cap (no identity to
+        # key the table on).
+        self._snap_last_by: Dict[int, float] = {}
+        #: relayer -> highest timestamp accepted. Requests must carry a
+        #: STRICTLY increasing ts per relayer: a captured request's ts
+        #: was already consumed, so replays are refused WITHOUT charging
+        #: the victim's throttle slot — an on-path replay stream cannot
+        #: starve the honest requester out of its own budget.
+        self._snap_ts_by: Dict[int, float] = {}
+        self._snap_last_global = float("-inf")
         self._snap_min_interval = snapshot_min_interval_s
+        # Freshness window is generous (5 min default, operator-tunable,
+        # None disables): its job is bounding the replay/state horizon,
+        # not tight clock agreement — a recovering node with pre-NTP
+        # clock drift is exactly the node that needs the RPC. Skew
+        # refusals are counted distinctly (net_snapshot_stale_refusals,
+        # incremented only for MAC-valid requests) so a wedged-by-skew
+        # committee member is diagnosable on the donor.
+        self._snap_freshness = snapshot_freshness_s
+        # Serialized-window cache: bounds donor-side SERIALIZATION work
+        # at one provider call per TTL no matter how many authenticated
+        # relayers ask (built under the lock — concurrent misses at TTL
+        # expiry wait instead of each re-serializing).
+        self._snap_cache: Optional[bytes] = None
+        self._snap_cache_t = float("-inf")
+        # Global egress token bucket: per-relayer fairness alone lets f
+        # Byzantine members each pull a full-window blob per interval
+        # (~f blobs/s of response bandwidth from 44-byte requests). The
+        # bucket bounds sustained egress at ~1 blob/interval (burst 3).
+        # Starvation under the bucket is probabilistic, not permanent:
+        # an honest laggard retrying each interval competes with at
+        # most f in-interval requesters for the refill, so expected
+        # recovery is O(f) attempts, vs the unbounded wedge a hard
+        # per-requester denial would be.
+        self._snap_tokens = 3.0
+        self._snap_tok_t = time.monotonic()
 
     def service(self, handler_call_details):
         if handler_call_details.method == _METHOD:
@@ -77,33 +131,104 @@ class _DeliverHandler(grpc.GenericRpcHandler):
             # utils.checkpoint.restore_from_snapshot's trust model — so
             # INTEGRITY needs nothing here; AVAILABILITY does: each
             # response serializes the whole window, so requests are
-            # MAC-gated (when frame auth is configured) and globally
-            # rate-limited — a 0-byte request must not be a cheap
-            # CPU/bandwidth amplifier. Empty response = refusal; the
-            # honest recovery path just retries after a pump cycle.
+            # MAC-gated with a freshness window (when frame auth is
+            # configured) and rate-limited per authenticated relayer —
+            # a 0-byte request must not be a cheap CPU/bandwidth
+            # amplifier, and on plaintext gRPC a captured request must
+            # expire rather than burn the donor's budget forever. Empty
+            # response = refusal; the honest recovery path just retries
+            # after a pump cycle.
             def snap(request: bytes, context) -> bytes:
+                now = time.monotonic()
                 if self._auth is not None:
                     from dag_rider_tpu.transport.auth import TAG_BYTES
 
-                    if len(request) != 4 + TAG_BYTES:
+                    if len(request) != 4 + 8 + TAG_BYTES:
+                        self._inc("net_snapshot_rejects")
                         return b""
                     (relayer,) = struct.unpack_from("<I", request)
+                    (ts,) = struct.unpack_from("<d", request, 4)
+                    if not math.isfinite(ts):
+                        # NaN compares False with everything: it would
+                        # sail through the freshness AND replay gates,
+                        # then poison _snap_ts_by for that relayer.
+                        self._inc("net_snapshot_rejects")
+                        return b""
+                    # MAC first: the freshness/replay/throttle counters
+                    # below must describe authenticated committee
+                    # members, not unauthenticated noise, or the
+                    # skew-diagnosis signal is meaningless.
                     if not self._auth.check(
-                        relayer, _SNAP_DOMAIN, request[4:]
+                        relayer,
+                        _SNAP_DOMAIN + request[4:12],
+                        request[12:],
                     ):
+                        self._inc("net_snapshot_rejects")
                         return b""
-                import time as _t
-
+                    if (
+                        self._snap_freshness is not None
+                        and abs(time.time() - ts) > self._snap_freshness
+                    ):
+                        self._inc("net_snapshot_stale_refusals")
+                        return b""
+                    with self._snap_lock:
+                        prev_ts = self._snap_ts_by.get(
+                            relayer, float("-inf")
+                        )
+                        if ts == prev_ts:
+                            # Exact capture replay: refuse without
+                            # touching the relayer's throttle state, so
+                            # a replay stream can never starve the
+                            # victim out of its own budget.
+                            self._inc("net_snapshot_replays")
+                            return b""
+                        if ts < prev_ts:
+                            # Older-than-accepted: a reordered capture
+                            # OR the requester's clock stepped backward
+                            # (e.g. first NTP sync mid-recovery) —
+                            # indistinguishable here, so count it as
+                            # staleness, not attack. The requester side
+                            # keeps its ts monotone within a process
+                            # (fetch_snapshot), so honest lockout is
+                            # bounded to a restart-plus-backward-step,
+                            # itself capped by the freshness window.
+                            self._inc("net_snapshot_stale_refusals")
+                            return b""
+                        last = self._snap_last_by.get(relayer, float("-inf"))
+                        if now - last < self._snap_min_interval:
+                            self._inc("net_snapshot_throttled")
+                            return b""
+                        # refill, then check one global egress token
+                        gap = now - self._snap_tok_t
+                        self._snap_tokens = min(
+                            3.0,
+                            self._snap_tokens
+                            + gap / self._snap_min_interval,
+                        )
+                        self._snap_tok_t = now
+                        if self._snap_tokens < 1.0:
+                            self._inc("net_snapshot_global_throttled")
+                            return b""
+                        # All gates passed: serve, then commit throttle
+                        # state only on SUCCESS — a failing provider
+                        # must not burn the requester's token/slot/ts
+                        # on an empty response.
+                        blob = self._serve_cached()
+                        if blob:
+                            self._snap_tokens -= 1.0
+                            self._snap_last_by[relayer] = now
+                            self._snap_ts_by[relayer] = ts
+                        return blob
+                # No identity to throttle on: stricter global cap.
                 with self._snap_lock:
-                    now = _t.monotonic()
-                    if now - self._snap_last < self._snap_min_interval:
+                    gap = now - self._snap_last_global
+                    if gap < 2.0 * self._snap_min_interval:
+                        self._inc("net_snapshot_throttled")
                         return b""
-                    self._snap_last = now
-                try:
-                    return self._snapshot()
-                except Exception:  # noqa: BLE001 — a failing provider
-                    # must not crash the server thread; empty = refuse
-                    return b""
+                    blob = self._serve_cached()
+                    if blob:
+                        self._snap_last_global = now
+                    return blob
 
             return grpc.unary_unary_rpc_method_handler(
                 snap,
@@ -111,6 +236,36 @@ class _DeliverHandler(grpc.GenericRpcHandler):
                 response_serializer=_identity,
             )
         return None
+
+    def _serve_cached(self) -> bytes:
+        """Serve the window blob, serialized at most once per TTL.
+
+        Caller holds ``_snap_lock`` — concurrent misses at TTL expiry
+        wait here instead of each re-serializing (the donor-side cost a
+        request flood could otherwise amplify). Returns b"" (refusal)
+        if the provider fails; the expired cache is released before the
+        rebuild so a multi-MB stale blob isn't pinned across a failing
+        provider."""
+        now = time.monotonic()
+        if (
+            self._snap_cache is not None
+            and now - self._snap_cache_t < self._snap_min_interval
+        ):
+            return self._snap_cache
+        self._snap_cache = None
+        try:
+            blob = self._snapshot()
+        except Exception:  # noqa: BLE001 — a failing provider must not
+            # crash the server thread; empty = refuse. Negative-cache
+            # the failure for one TTL: without it, every request during
+            # a provider outage would invoke the (possibly expensive,
+            # possibly repeatedly-failing) serialization at line rate —
+            # unthrottled, since refusals deliberately charge no
+            # throttle state.
+            blob = b""
+        self._snap_cache = blob
+        self._snap_cache_t = time.monotonic()
+        return blob
 
 
 class GrpcTransport(Transport):
@@ -134,6 +289,8 @@ class GrpcTransport(Transport):
         metrics: Optional[Metrics] = None,
         auth=None,
         snapshot_provider: Optional[Callable[[], bytes]] = None,
+        snapshot_min_interval_s: float = 1.0,
+        snapshot_freshness_s: Optional[float] = 300.0,
     ):
         self.index = index
         self._peers = dict(peers)
@@ -156,6 +313,7 @@ class GrpcTransport(Transport):
         self._rpc_timeout_s = rpc_timeout_s
         self._timers: set = set()
         self._closed = False
+        self._snap_req_ts = float("-inf")  # monotone request-ts floor
         # Observability (round-2 VERDICT weak #8: RpcErrors were silently
         # swallowed — a flaky peer degraded to permanent round lag with
         # zero counter movement). Shared with the process's Metrics when
@@ -174,7 +332,19 @@ class GrpcTransport(Transport):
             futures.ThreadPoolExecutor(max_workers=max_workers)
         )
         self._server.add_generic_rpc_handlers(
-            (_DeliverHandler(self._on_rpc, snapshot_provider, auth),)
+            (
+                _DeliverHandler(
+                    self._on_rpc,
+                    snapshot_provider,
+                    auth,
+                    snapshot_min_interval_s=snapshot_min_interval_s,
+                    # operator knob: fleets with known clock skew widen
+                    # the window (or None to disable freshness checking
+                    # entirely) rather than wedge recovering nodes
+                    snapshot_freshness_s=snapshot_freshness_s,
+                    metrics_inc=self._inc,
+                ),
+            )
         )
         self.bound_port = self._server.add_insecure_port(listen_addr)
         self._server.start()
@@ -384,8 +554,20 @@ class GrpcTransport(Transport):
         self._inc("net_snapshot_fetches")
         req = b""
         if self._auth is not None:
-            req = struct.pack("<I", self.index) + self._auth.tag(
-                peer, _SNAP_DOMAIN
+            # Coarse wall-clock timestamp under the MAC: the donor
+            # rejects stale requests, so a captured frame on plaintext
+            # gRPC cannot be replayed indefinitely to burn its budget.
+            # Kept strictly monotone within this process so a backward
+            # clock step (first NTP sync mid-recovery) cannot make our
+            # own requests read as stale/replayed at the donor.
+            with self._lock:
+                t = max(time.time(), self._snap_req_ts + 1e-3)
+                self._snap_req_ts = t
+            ts = struct.pack("<d", t)
+            req = (
+                struct.pack("<I", self.index)
+                + ts
+                + self._auth.tag(peer, _SNAP_DOMAIN + ts)
             )
         try:
             self._stub(peer)  # ensures the peer channel exists (locked)

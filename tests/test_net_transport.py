@@ -496,3 +496,115 @@ def test_update_peer_repoints_stale_channel():
     assert got2, "delivery after update_peer failed"
     a.close()
     b2.close()
+
+
+def test_snapshot_rpc_hardening():
+    """Round-5 review: the Snapshot endpoint must (a) serve authenticated
+    fresh requests from a serialized-once cache, (b) throttle per relayer
+    without letting replayed captures charge the victim's slot, (c) bound
+    global egress with a token bucket, (d) refuse stale timestamps with a
+    distinct counter, MAC-checked first."""
+    import struct as _struct
+    import time as _time
+
+    import grpc
+
+    from dag_rider_tpu.transport.auth import FrameAuth
+    from dag_rider_tpu.transport.net import _SNAP_DOMAIN
+
+    auths = FrameAuth.derive(b"m", 8)
+    calls = [0]
+
+    def provider():
+        calls[0] += 1
+        return b"w" * 256
+
+    # Donor A: long interval so every throttle assertion is deterministic
+    # however slow the host is (no wall-clock races).
+    donor = GrpcTransport(
+        0, "127.0.0.1:0", {}, auth=auths[0], snapshot_provider=provider,
+        snapshot_min_interval_s=60.0,
+    )
+    peers = {0: f"127.0.0.1:{donor.bound_port}"}
+    fetchers = [
+        GrpcTransport(i, "127.0.0.1:0", dict(peers), auth=auths[i])
+        for i in (1, 2, 3)
+    ]
+    try:
+        # burst of 3 distinct relayers: all served (bucket), 1 serialization
+        for f in fetchers:
+            assert f.fetch_snapshot(0) == b"w" * 256
+        assert calls[0] == 1, f"cache missed: {calls[0]}"
+        # 4th distinct relayer in the same burst: global bucket empty
+        extra = GrpcTransport(4, "127.0.0.1:0", dict(peers), auth=auths[4])
+        try:
+            assert extra.fetch_snapshot(0) is None
+        finally:
+            extra.close()
+        snap = donor.metrics.snapshot()
+        assert snap.get("net_snapshot_global_throttled", 0) >= 1, snap
+        # same relayer again inside the interval: per-relayer throttle
+        assert fetchers[0].fetch_snapshot(0) is None
+        snap = donor.metrics.snapshot()
+        assert snap.get("net_snapshot_throttled", 0) >= 1, snap
+    finally:
+        donor.close()
+        for f in fetchers:
+            f.close()
+
+    # Donor B: tiny interval so replay/stale classification is exercised
+    # without sleeping through a refill.
+    donor = GrpcTransport(
+        0, "127.0.0.1:0", {}, auth=auths[0], snapshot_provider=provider,
+        snapshot_min_interval_s=0.01,
+    )
+    raw = grpc.insecure_channel(f"127.0.0.1:{donor.bound_port}")
+    try:
+        call = raw.unary_unary(
+            "/dagrider.Transport/Snapshot",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        # replayed capture: a relayer's ts is consumed on first serve ->
+        # the exact replay gets a distinct refusal that does NOT charge
+        # the victim's throttle slot
+        ts = _struct.pack("<d", _time.time())
+        req2 = (
+            _struct.pack("<I", 5)
+            + ts
+            + auths[5].tag(0, _SNAP_DOMAIN + ts)
+        )
+        assert bytes(call(req2, timeout=5)) != b""  # fresh ts: served
+        assert bytes(call(req2, timeout=5)) == b""  # exact replay refused
+        snap = donor.metrics.snapshot()
+        assert snap.get("net_snapshot_replays", 0) == 1, snap
+        # an OLDER ts from the same relayer: classified stale (clock
+        # step / reordered capture), not replay
+        older = _struct.pack("<d", _time.time() - 30)
+        req_older = (
+            _struct.pack("<I", 5)
+            + older
+            + auths[5].tag(0, _SNAP_DOMAIN + older)
+        )
+        assert bytes(call(req_older, timeout=5)) == b""
+        snap = donor.metrics.snapshot()
+        assert snap.get("net_snapshot_stale_refusals", 0) == 1, snap
+        # out-of-freshness-window but MAC-valid: stale counter too
+        old = _struct.pack("<d", _time.time() - 3600)
+        req_old = (
+            _struct.pack("<I", 3)
+            + old
+            + auths[3].tag(0, _SNAP_DOMAIN + old)
+        )
+        assert bytes(call(req_old, timeout=5)) == b""
+        snap = donor.metrics.snapshot()
+        assert snap.get("net_snapshot_stale_refusals", 0) == 2, snap
+        # garbage of the right length: reject WITHOUT touching stale counter
+        junk = b"\xff" * len(req_old)
+        assert bytes(call(junk, timeout=5)) == b""
+        snap = donor.metrics.snapshot()
+        assert snap.get("net_snapshot_stale_refusals", 0) == 2, snap
+        assert snap.get("net_snapshot_rejects", 0) >= 1, snap
+    finally:
+        raw.close()
+        donor.close()

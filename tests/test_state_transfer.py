@@ -301,6 +301,132 @@ def test_stale_nacks_do_not_count_after_catching_up():
     assert list(p._horizon_nacks) == [2]
 
 
+def _full_local_rounds(p: Process, hi: int, sources=(0, 1, 2)) -> None:
+    """Rounds 1..hi from `sources` directly into the DAG (source 3 is the
+    permanently-absent straggler whose history peers have pruned)."""
+    from dag_rider_tpu.core.types import Vertex
+
+    for r in range(1, hi + 1):
+        prev = tuple(VertexID(r - 1, s) for s in sources)
+        for s in sources:
+            p.dag.insert(Vertex(id=VertexID(r, s), strong_edges=prev))
+    p.round = hi
+
+
+def test_attested_peer_floor_unwedges_blocked_buffer():
+    """ADVICE r4: a node whose round is AHEAD of peers' floors but whose
+    buffer is blocked on pruned straggler rounds must act on nacks whose
+    floor exceeds the requested lo — not re-request unservable history
+    forever. f+1 distinct floors above lo attest a pruned horizon, and
+    the requester stops targeting blockers at/below it. Admission is
+    deliberately untouched (round-5 review): f+1 floors prove ONE
+    honest peer pruned, not that no honest peer can serve — blocked
+    vertices stay buffered (bounded memory, zero traffic) in case a
+    lower-floor peer serves their predecessors later; dropping them
+    could forfeit that recovery and fork our delivered log."""
+    from dag_rider_tpu.core.types import Vertex
+
+    cfg = Config(
+        n=4, coin="round_robin", propose_empty=True, sync_patience=1
+    )  # gc_depth=None: the LOCAL floor never advances (the wedge case)
+    p = Process(cfg, 0, InMemoryTransport())
+    _full_local_rounds(p, 10)
+    # three stragglers from source 3, all blocked:
+    v_low = Vertex(  # inside the soon-attested horizon
+        id=VertexID(6, 3),
+        block=Block((b"low",)),
+        strong_edges=(VertexID(5, 0), VertexID(5, 1), VertexID(5, 3)),
+    )
+    v_strong = Vertex(  # att+1, strong pred in attested history
+        id=VertexID(9, 3),
+        block=Block((b"strong",)),
+        strong_edges=(VertexID(8, 0), VertexID(8, 1), VertexID(8, 3)),
+    )
+    v_weak = Vertex(  # above the horizon, missing weak target under it
+        id=VertexID(10, 3),
+        block=Block((b"weak",)),
+        strong_edges=(VertexID(9, 0), VertexID(9, 1), VertexID(9, 2)),
+        weak_edges=(VertexID(7, 3),),
+    )
+    for v in (v_low, v_strong, v_weak):
+        p.on_message(BroadcastMessage(vertex=v, round=v.round, sender=3))
+    p._started = True
+    p.step()
+    assert {v_low.id, v_strong.id, v_weak.id} <= p._buffered_ids
+
+    # stuck -> sync request fires at lo = min blocker round (5)
+    outbox = []
+    p.transport.broadcast = lambda m: outbox.append(m)
+    p._maybe_request_sync()
+    reqs = [m for m in outbox if m.kind == "sync"]
+    assert reqs and reqs[0].round == 5
+    assert p._sync_last_lo == 5
+
+    # f+1 = 2 distinct responders nack with floor 8 (> lo, <= our round)
+    for sender in (1, 2):
+        p._on_sync_nack(
+            BroadcastMessage(
+                vertex=None, round=8, sender=sender, kind="sync_nack",
+                origin=0,
+            )
+        )
+    assert p._attested_floor == 8
+    assert not p.state_transfer_needed  # floors <= our round: no rewind
+    # admission untouched: everything stays buffered (a lower-floor
+    # peer may yet serve the predecessors), nothing was admitted
+    assert {v_low.id, v_strong.id, v_weak.id} <= p._buffered_ids
+    assert not p.dag.present(v_weak.id)
+    # but the requester stops asking for the attested-pruned window —
+    # the actual wedge: before the fix this re-requested lo=5 forever
+    outbox.clear()
+    p._sync_last_request = float("-inf")  # cooldown passed
+    p._stuck_steps = 10**6
+    p._maybe_request_sync()
+    reqs = [m for m in outbox if m.kind == "sync"]
+    assert reqs == [] or reqs[0].round > 8
+    # the machine keeps running; ordering never touches the hole
+    for _ in range(5):
+        p.step()
+    # if a lower-floor peer later serves the missing history, recovery
+    # still happens: deliver the round-5..9 stragglers and watch the
+    # whole chain admit
+    from dag_rider_tpu.core.types import Vertex as _V
+
+    for r in range(5, 10):
+        prev = tuple(VertexID(r - 1, s) for s in (0, 1, 2))
+        p.on_message(
+            BroadcastMessage(
+                vertex=_V(id=VertexID(r, 3), strong_edges=prev),
+                round=r,
+                sender=3,
+            )
+        )
+    p.step()
+    assert p.dag.present(v_low.id) and p.dag.present(v_strong.id)
+    assert p.dag.present(v_weak.id)
+
+
+def test_attested_floor_clips_byzantine_inflation():
+    """A single Byzantine nack with a huge floor must not drag the
+    attested floor past what an honest responder corroborates: the
+    (f+1)-th largest reported value is the bound."""
+    p = Process(GC, 0, InMemoryTransport())
+    p.round = 10
+    p._sync_last_lo = 5
+    p._on_sync_nack(
+        BroadcastMessage(
+            vertex=None, round=10**9, sender=1, kind="sync_nack", origin=0
+        )
+    )
+    assert p._attested_floor == 0  # one claim is not a quorum
+    p._on_sync_nack(
+        BroadcastMessage(
+            vertex=None, round=8, sender=2, kind="sync_nack", origin=0
+        )
+    )
+    assert p._attested_floor == 8  # clipped to the corroborated value
+
+
 def test_snapshot_corruption_fuzz_never_crashes_or_corrupts():
     """Seeded fuzz over the untrusted-snapshot surface: random bit
     flips, truncations and splices must either refuse (False, receiver
