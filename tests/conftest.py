@@ -34,3 +34,49 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dag_rider_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
+
+
+# Long-tail tests (>= ~10 s each on this host, measured with
+# --durations=50; together ~75% of suite wall time). Kept here as the
+# single source of truth instead of scattering @pytest.mark.slow
+# decorators — re-measure and update when the profile shifts.
+_SLOW = {
+    "test_pallas_group381.py::test_msm_kernel_pallas_impl_traces",
+    "test_pallas_group381.py::test_padd381_pallas_program_traces",
+    "test_bls_msm.py::test_scalar_mul_matches_host",
+    "test_bls_msm.py::test_field_ring_ops_match_host",
+    "test_bls_msm.py::test_msm_matches_host[1]",
+    "test_bls_msm.py::test_msm_matches_host[5]",
+    "test_net_transport.py::test_grpc_16_node_cluster_with_rbc_reaches_consensus",
+    "test_full_stack.py::test_seven_nodes_two_equivocators_with_rbc",
+    "test_full_stack.py::test_full_stack_byzantine_coin_share_plus_faults",
+    "test_comb.py::test_comb_mask_matches_windowed_and_cpu",
+    "test_parallel.py::test_sharded_comb_pallas_path_traces",
+    "test_parallel.py::test_sharded_mask_equals_single_device_and_cpu",
+    "test_parallel.py::test_sharded_msm_matches_host_oracle",
+    "test_parallel.py::test_sharded_verifier_large_batch_matches_cpu_oracle",
+    "test_parallel.py::test_round_step_matches_host_twins_on_figure1",
+    "test_pallas_group.py::test_finish_kernel_matches_jnp_tail",
+    "test_pallas_group.py::test_pow22523_kernel_matches_field",
+    "test_node.py::test_churn_restored_logs_stay_prefix_consistent",
+    "test_node.py::test_node_restart_from_checkpoint_catches_up",
+    "test_determinism.py::test_pipelined_coalesced_path_matches_sync_path",
+    "test_determinism.py::test_device_verify_is_deterministic",
+    "test_determinism.py::test_cpu_vs_device_verifier_commit_order_byte_identical",
+    "test_coin_e2e.py::test_byzantine_share_cannot_stall_the_coin",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two-tier lanes (SURVEY §4): tests in _SLOW get @slow, everything
+    else gets @fast — so `pytest -m fast` (inner loop, ~3 min) and
+    `pytest -m slow` (long tail) partition the suite; a bare `pytest`
+    still runs everything."""
+    import pytest as _pytest
+
+    for item in items:
+        name = item.nodeid.split("/")[-1]
+        if name in _SLOW or "slow" in item.keywords:
+            item.add_marker(_pytest.mark.slow)
+        else:
+            item.add_marker(_pytest.mark.fast)
