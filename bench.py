@@ -52,6 +52,20 @@ def _mark(msg: str) -> None:
     print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def _relay_log(msg: str) -> None:
+    """Persist a wall-clock-timestamped relay-health line (round-4 VERDICT
+    #1: make a wedged relay distinguishable from a compile timeout after
+    the fact — stderr is lost once the driver truncates it)."""
+    try:
+        import datetime
+
+        stamp = datetime.datetime.now().isoformat(timespec="seconds")
+        with open(os.path.join(_REPO, "relay_health.log"), "a") as fh:
+            fh.write(f"{stamp} {msg}\n")
+    except OSError:
+        pass
+
+
 # ----------------------------------------------------------------------
 # Stage: probe (backend init + one tiny dispatch)
 # ----------------------------------------------------------------------
@@ -191,6 +205,7 @@ def _sim_rung(
     chunk: int,
     coin: str = "round_robin",
     gc_depth: int = 24,
+    pipelined: bool = True,
 ):
     """Time-boxed consensus-in-the-loop simulation (BASELINE configs #3/#4
     live halves): n processes, shared device verifier (coalesced + async
@@ -243,11 +258,23 @@ def _sim_rung(
         signer_factory=lambda i: signers[i],
     )
     sim.submit_blocks(per_process=2)
-    t0 = _t.monotonic()
-    pumped = 0
-    while _t.monotonic() - t0 < box_s:
-        pumped += sim.run(max_messages=chunk)
-    dt = _t.monotonic() - t0
+    if not pipelined:
+        # Shadow the async seam with instance attributes: Simulation.run
+        # sees dispatch_batch None and takes the synchronous path — the
+        # before/after evidence for how much the dispatch/delivery
+        # overlap cuts wave-commit p50 (round-4 VERDICT #4).
+        verifier.dispatch_batch = None
+        verifier.resolve_batch = None
+    try:
+        t0 = _t.monotonic()
+        pumped = 0
+        while _t.monotonic() - t0 < box_s:
+            pumped += sim.run(max_messages=chunk)
+        dt = _t.monotonic() - t0
+    finally:
+        if not pipelined:
+            del verifier.dispatch_batch
+            del verifier.resolve_batch
     sigs = sum(p.metrics.verify_sigs_total for p in sim.processes)
     waves = [
         s for p in sim.processes for s in p.metrics.wave_commit_seconds
@@ -257,11 +284,17 @@ def _sim_rung(
     return {
         "nodes": n,
         "coin": entry_coin,
+        "pipelined": pipelined,
         "seconds": round(dt, 1),
         "messages": pumped,
         "sigs_verified": sigs,
         "sigs_per_sec": round(sigs / dt, 1),
         "vertices_delivered_total": delivered,
+        # per-view DAG size (BASELINE config #3's "10k-vertex DAG" is
+        # per view, not summed across the n copies)
+        "vertices_delivered_per_view": max(
+            (len(d) for d in sim.deliveries), default=0
+        ),
         "max_round": max(p.round for p in sim.processes),
         # bounded-memory evidence: cumulative DAG size vs live window
         "vertices_live_max": max(
@@ -329,6 +362,11 @@ def _measure() -> None:
         backend — PROFILE.md round 3 — so the steady-state consensus shape
         amortizes it across consecutive rounds)."""
         if n not in built:
+            return
+        if left() < 45:
+            # the merged bucket is a SECOND program compile — on a CPU
+            # fallback it can eat minutes and starve later rungs
+            _mark(f"skipping merged_n{n} (left {left():.0f}s)")
             return
         verifier, batches, _ = built[n]
         rounds = batches[1:]
@@ -438,27 +476,33 @@ def _measure() -> None:
     #  - CPU fallback: n=64 first (n=256 would burn the whole fallback
     #    window compiling; DAGRIDER_BENCH_N256_MIN gates it off).
     n256_min = float(os.environ.get("DAGRIDER_BENCH_N256_MIN", "150"))
+    # On-device: 63 built rounds so the merged phase dispatches a ~16k-
+    # signature program (measured 50.6k sigs/s at 16384, 57.7k at 32768 —
+    # PROFILE.md round 3). The CPU fallback shrinks this (round-4 VERDICT
+    # #6: the fallback must still *measure the north-star committee size*,
+    # which it can afford only with a small merged burst).
+    n256_rounds = int(os.environ.get("DAGRIDER_BENCH_N256_ROUNDS", "63"))
     headline_first = backend != "cpu" and left() > n256_min
 
     if headline_first:
-        # n=256 (the north-star committee size). 63 built rounds so the
-        # merged phase dispatches a ~16k-signature program (measured
-        # 50.6k sigs/s at 16384, 57.7k at 32768 — PROFILE.md round 3),
-        # but only 4 synchronizing per-round timing samples.
-        if verify_phase(256, timed_rounds=4, built_rounds=63):
+        # n=256 (the north-star committee size) first, with only 4
+        # synchronizing per-round timing samples.
+        if verify_phase(256, timed_rounds=4, built_rounds=n256_rounds):
             merged_phase(256)
         if left() > 30:
             verify_phase(64, timed_rounds=4)
     else:
         # n=64 first: small program compiles fast; guarantees a number.
+        # The merged phase is DEFERRED to the end of the stage on this
+        # path (cpu_merged_n below): its second program compile must not
+        # starve the host-consensus/coin rungs of the fallback window.
         verify_phase(64, timed_rounds=4)
+        cpu_merged_n = 64
         if left() > n256_min:
-            if verify_phase(256, timed_rounds=4, built_rounds=63):
-                merged_phase(256)
+            if verify_phase(256, timed_rounds=4, built_rounds=n256_rounds):
+                cpu_merged_n = 256
         else:
             _mark(f"skipping n=256 (only {left():.0f}s left)")
-            if left() > 40:
-                merged_phase(64)
 
     # -- phase C: wave-commit pipeline latency at the measured n
     if left() > 30 and result["n"]:
@@ -536,6 +580,31 @@ def _measure() -> None:
             f"wave p50 {entry['wave_commit_p50_ms']} ms"
         )
         emit()
+        # before/after overlap evidence (round-4 VERDICT #4): the same
+        # rung with the dispatch/delivery pipeline forced OFF — the p50
+        # delta is what the overlap buys at the north-star committee
+        sync_budget = float(
+            os.environ.get("DAGRIDER_BENCH_SIM256_SYNC_S", "25")
+        )
+        if sync_budget > 0 and left() > sync_budget + 30:
+            _mark(f"ladder sim256_sync: {sync_budget:.0f}s, pipeline OFF")
+            entry = _sim_rung(
+                256,
+                sync_budget,
+                verifier,
+                signers,
+                bucket=16384,
+                chunk=256 * 255,
+                coin="threshold_bls",
+                pipelined=False,
+            )
+            result["ladder"]["sim256_sync"] = entry
+            _mark(
+                f"ladder sim256_sync: wave p50 "
+                f"{entry['wave_commit_p50_ms']} ms "
+                f"({entry['sigs_per_sec']:,.0f} sigs/s)"
+            )
+            emit()
     else:
         _mark(f"skipping ladder sim256 (left {left():.0f}s)")
 
@@ -587,23 +656,23 @@ def _measure() -> None:
     # covered by sim64/sim256; the CPU fallback sets
     # DAGRIDER_BENCH_HOSTSIM_S so the official record still carries a
     # consensus number when the chip is unreachable.
-    hostsim_s = float(os.environ.get("DAGRIDER_BENCH_HOSTSIM_S", "0"))
-    if hostsim_s > 0 and left() > hostsim_s + 10:
-        _mark(f"ladder sim64_host: {hostsim_s:.0f}s null-verifier consensus")
+    def host_rung(n: int, secs: float) -> None:
+        tag = f"sim{n}_host"
+        _mark(f"ladder {tag}: {secs:.0f}s null-verifier consensus")
         from dag_rider_tpu.config import Config
         from dag_rider_tpu.consensus.simulator import Simulation
 
-        cfg = Config(n=64, coin="round_robin", propose_empty=True, gc_depth=24)
+        cfg = Config(n=n, coin="round_robin", propose_empty=True, gc_depth=24)
         sim = Simulation(cfg)
         sim.submit_blocks(per_process=2)
         t0 = time.monotonic()
         pumped = 0
-        while time.monotonic() - t0 < hostsim_s:
-            pumped += sim.run(max_messages=4032)
+        while time.monotonic() - t0 < secs:
+            pumped += sim.run(max_messages=n * (n - 1))
         dt = time.monotonic() - t0
         sim.check_agreement()
-        result["ladder"]["sim64_host"] = {
-            "nodes": 64,
+        result["ladder"][tag] = {
+            "nodes": n,
             "verifier": "none",
             "seconds": round(dt, 1),
             "messages": pumped,
@@ -618,10 +687,20 @@ def _measure() -> None:
             "agreement": True,
         }
         _mark(
-            f"ladder sim64_host: {pumped / dt:,.0f} msg/s, round "
-            f"{result['ladder']['sim64_host']['max_round']}, agreement ok"
+            f"ladder {tag}: {pumped / dt:,.0f} msg/s, round "
+            f"{result['ladder'][tag]['max_round']}, agreement ok"
         )
         emit()
+
+    hostsim_s = float(os.environ.get("DAGRIDER_BENCH_HOSTSIM_S", "0"))
+    if hostsim_s > 0 and left() > hostsim_s + 10:
+        host_rung(64, hostsim_s)
+    # n=256 host consensus (round-4 VERDICT #6: even a wedged-relay round
+    # must record consensus behavior at the committee size the baseline
+    # is defined at)
+    hostsim256_s = float(os.environ.get("DAGRIDER_BENCH_HOSTSIM256_S", "0"))
+    if hostsim256_s > 0 and left() > hostsim256_s + 10:
+        host_rung(256, hostsim256_s)
 
     # -- ladder rung #4: 256-node threshold coin with one Byzantine share
     if left() > 30:
@@ -819,6 +898,10 @@ def _measure() -> None:
             result["phases"]["pallas_field_mul"] = {"error": repr(e)[:200]}
             _mark(f"pallas probe FAILED (non-fatal): {e!r}")
             emit()
+    if not headline_first:
+        # deferred CPU merged phase: only with whatever window remains
+        # after every rung has had its chance (guarded inside)
+        merged_phase(cpu_merged_n)
     _mark("measure: done")
     emit()
 
@@ -887,7 +970,8 @@ def main() -> None:
         return
 
     budget = float(os.environ.get("DAGRIDER_BENCH_BUDGET", "540"))
-    cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "130"))
+    # enough for the n=256 phases the fallback now carries (VERDICT #6)
+    cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "180"))
     notes = []
 
     def elapsed() -> float:
@@ -900,13 +984,20 @@ def main() -> None:
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
         env["DAGRIDER_BENCH_SECONDS"] = str(timeout_s - 15.0)
-        env["DAGRIDER_BENCH_N256_MIN"] = "10000"  # skip n=256 on CPU
+        # North-star-shaped evidence even when the chip is unreachable
+        # (round-4 VERDICT #6): measure verify at n=256 with a SMALL
+        # merged burst (6 rounds ~ 1.5k sigs — the full 63-round burst
+        # is a device shape that would eat the whole CPU window), plus
+        # an n=256 host consensus rung.
+        env["DAGRIDER_BENCH_N256_MIN"] = "90"
+        env["DAGRIDER_BENCH_N256_ROUNDS"] = "6"
         # One 64-node consensus chunk costs ~a minute of CPU verify
         # dispatches, and the T=1024 MSM runs ~70s/warm-run on CPU —
         # both rungs are TPU-only.
         env["DAGRIDER_BENCH_SIM_S"] = "0"
         env["DAGRIDER_BENCH_SIM256_S"] = "0"
-        env["DAGRIDER_BENCH_HOSTSIM_S"] = "15"  # host consensus evidence
+        env["DAGRIDER_BENCH_HOSTSIM_S"] = "12"  # host consensus evidence
+        env["DAGRIDER_BENCH_HOSTSIM256_S"] = "15"
         env["DAGRIDER_BENCH_MSM_T"] = "0"
         env["DAGRIDER_BENCH_N1024"] = "0"
         env["DAGRIDER_BENCH_PALLAS"] = "0"  # Mosaic needs the real chip
@@ -914,23 +1005,32 @@ def main() -> None:
 
     # Probe retry ladder (round-3 postmortem: BENCH_r03 lost the on-chip
     # headline because the single probe hit a transiently wedged relay and
-    # the whole remaining budget went to the CPU fallback). Now: up to 3
-    # probe attempts across the budget, with the CPU fallback banking a
-    # number BETWEEN attempts rather than terminally, so a relay that
-    # recovers mid-run still gets measured.
+    # the whole remaining budget went to the CPU fallback; round-4 VERDICT
+    # #1: attempts must CONTINUE after the CPU fallback banks, not stop).
+    # Loop: probe -> on success measure on the chip; on failure bank a CPU
+    # number once, then keep re-probing on a 30 s cadence until the budget
+    # can no longer fit a probe + minimal measurement — a relay that
+    # recovers at any point in the run still gets measured.
     result = None
     cpu_result = None
     probe = None
-    probe_timeouts = [min(120.0, budget / 4), 60.0, 60.0]
-    for attempt, pt in enumerate(probe_timeouts, start=1):
-        pt = min(pt, max(25.0, budget - elapsed() - 90.0))
-        if budget - elapsed() < 110.0:
-            break  # not enough room left for probe + any measurement
+    attempt = 0
+    while budget - elapsed() >= 110.0:
+        attempt += 1
+        pt = min(
+            120.0 if attempt == 1 else 60.0,
+            max(25.0, budget - elapsed() - 90.0),
+        )
         _mark(f"outer: probing primary backend, attempt {attempt} (timeout {pt:.0f}s)")
+        _relay_log(f"probe attempt {attempt} start (timeout {pt:.0f}s)")
         probe_i, tail = _run_stage("probe", dict(os.environ), pt)
         if probe_i and probe_i.get("probe_ok"):
             probe = probe_i
             _mark(f"outer: probe ok ({probe})")
+            _relay_log(
+                f"probe attempt {attempt} OK: backend="
+                f"{probe.get('backend')} init_s={probe.get('init_s')}"
+            )
             # full measurement on the primary backend; reserve CPU time
             # only if no CPU number is banked yet
             reserve = cpu_reserve if cpu_result is None else 0.0
@@ -939,6 +1039,10 @@ def main() -> None:
             env["DAGRIDER_BENCH_SECONDS"] = str(meas_timeout - 20.0)
             _mark(f"outer: measuring on primary (timeout {meas_timeout:.0f}s)")
             result, mtail = _run_stage("measure", env, meas_timeout)
+            _relay_log(
+                "primary measure "
+                + ("ok" if result and result.get("value") else f"failed: {mtail[:200]}")
+            )
             if result is None or not result.get("value"):
                 notes.append(f"primary measure: {mtail}")
                 if result is not None:
@@ -947,16 +1051,21 @@ def main() -> None:
             break
         notes.append(f"probe attempt {attempt} failed: {tail}")
         _mark(f"outer: probe attempt {attempt} FAILED ({tail})")
-        if cpu_result is None and budget - elapsed() > 200.0:
+        _relay_log(f"probe attempt {attempt} FAILED: {tail[:300]}")
+        if cpu_result is None and budget - elapsed() > cpu_reserve + 130.0:
             # bank a CPU number while waiting for the relay to recover
             cpu_timeout = max(60.0, min(cpu_reserve, budget - elapsed() - 100.0))
             _mark(f"outer: CPU fallback between probes (timeout {cpu_timeout:.0f}s)")
             cpu_result, ctail = run_cpu_fallback(cpu_timeout)
             if cpu_result is None:
                 notes.append(f"cpu fallback: {ctail}")
-        elif budget - elapsed() > 200.0:
-            _mark("outer: waiting 30s before next probe attempt")
-            time.sleep(30.0)
+        else:
+            # Always pace failed probes — a probe that fails in <1s
+            # (e.g. ImportError) must not spin the loop spawning
+            # subprocesses until the budget floor is hit.
+            wait = min(30.0, max(5.0, budget - elapsed() - 110.0))
+            _mark(f"outer: waiting {wait:.0f}s before next probe attempt")
+            time.sleep(wait)
 
     if result is None and cpu_result is None:
         # terminal CPU fallback — a number must always exist
@@ -980,7 +1089,13 @@ def main() -> None:
     if probe:
         result.setdefault("phases", {})["probe"] = probe
     if notes:
-        result["fallback_reason"] = " || ".join(notes)[-600:]
+        # Head-preserving truncation: each note keeps its lead (the
+        # attempt tag + rc), the join keeps the FIRST 800 chars — the
+        # round-4 record's tail-clip produced garbled reasons like
+        # "e attempt 2 failed: rc=timeout; ...".
+        result["fallback_reason"] = " || ".join(
+            n[:240] for n in notes
+        )[:800]
     print(json.dumps(result))
 
 

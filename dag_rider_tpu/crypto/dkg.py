@@ -1,0 +1,660 @@
+"""Joint-Feldman distributed key generation for the threshold-BLS coin.
+
+Replaces :meth:`ThresholdKeys.generate`'s trusted dealer — the exact gap
+its docstring names ("a real deployment runs a DKG so nobody ever holds
+the group secret", crypto/threshold.py) and the PKI the reference's TODO
+asks for ("PKI and a threshold signature scheme with a threshold of
+(f+1)-of-n", process/process.go:388). The output is drop-in
+:class:`~dag_rider_tpu.crypto.threshold.ThresholdKeys` material: Shamir
+x-coordinates are ``index + 1`` and the group public key lives in G2,
+matching ``threshold.aggregate`` / ``batch_verify_shares`` unchanged.
+
+Protocol (t-of-n, classic joint-Feldman):
+
+1. **Deal.** Every participant d samples a degree-(t-1) polynomial
+   ``f_d`` over Z_r, broadcasts Feldman commitments
+   ``C_{d,k} = g2^{a_{d,k}}`` and sends each participant j the share
+   ``s_{d,j} = f_d(j+1)`` over a *private* channel (here: XOR-pad +
+   HMAC under a pairwise key from ECDH over the committee's Ed25519
+   identities — :func:`channel_key` — so the consensus transport's
+   plaintext gRPC never sees a share).
+2. **Verify / complain.** j checks every received share against the
+   dealer's commitments: ``g2^{s_{d,j}} == sum_k (j+1)^k * C_{d,k}``
+   (evaluated in the exponent). Failures produce a public complaint.
+3. **Reveal / disqualify.** A complained-against dealer must reveal the
+   complained share publicly; everyone re-checks it against the
+   commitments. Invalid or missing reveals disqualify the dealer.
+   (Revealing a genuinely valid share only de-privatizes that one
+   share — the standard Feldman trade for a one-round complaint fix.)
+4. **Finalize.** With Q the qualified dealer set:
+   ``share_sk_j = sum_{d in Q} s_{d,j}``,
+   ``share_pk_i = prod_{d in Q} eval_d(i+1)``,
+   ``group_pk = prod_{d in Q} C_{d,0}``. The group secret
+   ``sum_{d in Q} a_{d,0}`` is never materialized anywhere.
+
+Security model notes: Feldman commitments leak ``g2^{a_{d,0}}`` (fine
+for BLS — the group pk is public anyway); bias via adaptive
+disqualification (Gennaro et al.) is out of scope for a coin whose only
+requirement is unpredictability-before-f+1-shares, which survives any
+qualified set containing one honest dealer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dag_rider_tpu.crypto import bls12381 as bls
+from dag_rider_tpu.crypto import ed25519 as ed
+from dag_rider_tpu.crypto.threshold import ThresholdKeys
+
+_SCALAR_BYTES = 32
+_G2_BYTES = 4 * 48  # uncompressed (x.a, x.b, y.a, y.b), 48B big-endian each
+_CHAN_DOMAIN = b"dagrider-dkg-chan-v1|"
+_PAD_DOMAIN = b"dagrider-dkg-pad-v1|"
+_TAG_DOMAIN = b"dagrider-dkg-tag-v1|"
+TAG_BYTES = 32
+
+
+# ---------------------------------------------------------------------------
+# G2 wire format (commitments). bls12381 has compressed G1 only; DKG
+# commitments are few (t per dealer, one-time), so uncompressed + full
+# validation beats implementing Fp2 square roots.
+# ---------------------------------------------------------------------------
+
+
+def g2_encode(p) -> bytes:
+    if p is None:
+        raise ValueError("cannot encode the identity commitment")
+    (xa, xb), (ya, yb) = p
+    return b"".join(v.to_bytes(48, "big") for v in (xa, xb, ya, yb))
+
+
+def _mul_unreduced(ops, zero, one, k: int, p):
+    """[k]P WITHOUT reducing k mod r — bls.g1_mul/g2_mul (correctly, for
+    their r-torsion inputs) map k == r to the identity before touching
+    P, so they cannot implement the [r]P == O membership test this file
+    needs. Plain Jacobian double-and-add; None is the identity
+    throughout (and a Z == 0 accumulator — doubling a point of even
+    order — collapses back to None before it can poison a mixed
+    addition)."""
+    acc = None
+    for bit in range(k.bit_length() - 1, -1, -1):
+        if acc is not None:
+            acc = bls._jac_double(ops, acc)
+            if acc is not None and acc[2] == zero:
+                acc = None
+        if (k >> bit) & 1:
+            acc = (
+                (p[0], p[1], one)
+                if acc is None
+                else bls._jac_madd(ops, acc, p, zero)
+            )
+    return bls._jac_to_affine(ops, acc, zero)
+
+
+def _g2_mul_unreduced(k: int, p):
+    return _mul_unreduced(bls._FP2_OPS, bls.FP2_ZERO, bls.FP2_ONE, k, p)
+
+
+def _g1_mul_unreduced(k: int, p):
+    """Same ladder over E(Fp) — exists so the membership primitive can be
+    exercised against easy-to-construct non-subgroup points (E(Fp) has
+    cofactor > 1 and its full-group points are a square-root scan away,
+    while the twist's are behind an Fp2 Tonelli-Shanks)."""
+    return _mul_unreduced(bls._FP_OPS, 0, 1, k, p)
+
+
+def g2_decode(data: bytes):
+    """Decode + validate one uncompressed G2 point.
+
+    Returns None on anything malformed: wrong length, coordinates >= p,
+    off the twist, or outside the r-order subgroup (the cofactor of the
+    twist is large; an adversarial commitment in a small subgroup would
+    corrupt everyone's derived share_pks undetectably, so the [r]P == O
+    check is not optional)."""
+    if len(data) != _G2_BYTES:
+        return None
+    xa, xb, ya, yb = (
+        int.from_bytes(data[i * 48 : (i + 1) * 48], "big") for i in range(4)
+    )
+    if max(xa, xb, ya, yb) >= bls.P:
+        return None
+    x, y = (xa, xb), (ya, yb)
+    # twist equation: y^2 = x^3 + 4(u+1)
+    lhs = bls.fp2_sqr(y)
+    rhs = bls.fp2_add(
+        bls.fp2_mul(bls.fp2_sqr(x), x), bls.fp2_scalar((4, 4), 1)
+    )
+    if lhs != rhs:
+        return None
+    p = (x, y)
+    if _g2_mul_unreduced(bls.R, p) is not None:  # subgroup membership
+        return None
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Private pairwise channels from the committee's Ed25519 identities
+# ---------------------------------------------------------------------------
+
+
+def channel_key(my_seed: bytes, peer_pk: bytes) -> Optional[bytes]:
+    """Symmetric pairwise key: SHA-512(DH point)[:32] with
+    DH = [a_i]A_j = [a_i a_j]B over edwards25519 (clamped scalars are
+    multiples of 8, so small-subgroup components vanish). k_ij == k_ji
+    because scalar multiplication commutes through the shared base."""
+    a, _, _ = ed.expand_seed(my_seed)
+    pt = ed.point_decompress(peer_pk)
+    if pt is None or not ed.on_curve(pt):
+        return None
+    shared = ed.scalar_mult(a, pt)
+    return hashlib.sha512(
+        _CHAN_DOMAIN + ed.point_compress(shared)
+    ).digest()[:32]
+
+
+def _share_nonce(dealer: int, recipient: int) -> bytes:
+    return dealer.to_bytes(4, "little") + recipient.to_bytes(4, "little")
+
+
+def encrypt_share(key: bytes, dealer: int, recipient: int, s: int) -> bytes:
+    """One-shot XOR-pad + MAC. The (dealer, recipient) pair encrypts
+    exactly one scalar per DKG run, so the deterministic nonce never
+    repeats under a key; the MAC binds the direction."""
+    nonce = _share_nonce(dealer, recipient)
+    pad = hashlib.sha512(_PAD_DOMAIN + key + nonce).digest()[:_SCALAR_BYTES]
+    ct = bytes(
+        a ^ b for a, b in zip(s.to_bytes(_SCALAR_BYTES, "little"), pad)
+    )
+    tag = hmac.new(key, _TAG_DOMAIN + nonce + ct, hashlib.sha256).digest()
+    return ct + tag
+
+
+def decrypt_share(
+    key: bytes, dealer: int, recipient: int, blob: bytes
+) -> Optional[int]:
+    if len(blob) != _SCALAR_BYTES + TAG_BYTES:
+        return None
+    ct, tag = blob[:_SCALAR_BYTES], blob[_SCALAR_BYTES:]
+    nonce = _share_nonce(dealer, recipient)
+    want = hmac.new(key, _TAG_DOMAIN + nonce + ct, hashlib.sha256).digest()
+    if not hmac.compare_digest(want, tag):
+        return None
+    pad = hashlib.sha512(_PAD_DOMAIN + key + nonce).digest()[:_SCALAR_BYTES]
+    return int.from_bytes(bytes(a ^ b for a, b in zip(ct, pad)), "little")
+
+
+# ---------------------------------------------------------------------------
+# The per-participant state machine (transport-agnostic)
+# ---------------------------------------------------------------------------
+
+
+def _eval_commitments(commits: Sequence, x: int):
+    """prod_k [x^k] C_k — the dealer's polynomial at x, in the exponent."""
+    xs = 1
+    scalars = []
+    for _ in commits:
+        scalars.append(xs)
+        xs = xs * x % bls.R
+    return bls.g2_msm(scalars, list(commits))
+
+
+class DkgSession:
+    """One participant's joint-Feldman run.
+
+    Drive it: broadcast :meth:`commitment_blob`, send each j its
+    :meth:`share_blob_for`; feed peers' traffic to :meth:`on_commitments`
+    / :meth:`on_share`; after the dealing round, broadcast
+    :meth:`complaints`; answer complaints against yourself with
+    :meth:`reveal_blob`; feed reveals to :meth:`on_reveal`; then
+    :meth:`finalize`. Message authenticity (who sent what) is the
+    transport's job — gRPC deployments wrap frames in FrameAuth exactly
+    like consensus traffic; share *confidentiality* is handled here.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        n: int,
+        threshold: int,
+        identity_seed: bytes,
+        identity_pks: Sequence[bytes],
+        *,
+        rng: Optional[bytes] = None,
+    ):
+        if not 1 <= threshold <= n:
+            raise ValueError("need 1 <= threshold <= n")
+        if len(identity_pks) != n:
+            raise ValueError("need one identity pk per participant")
+        self.index = index
+        self.n = n
+        self.t = threshold
+        self._seed = identity_seed
+        self._ids = list(identity_pks)
+        # polynomial: rng is for tests only; deployments use os.urandom
+        material = rng if rng is not None else os.urandom(64)
+        self._coeffs = [
+            int.from_bytes(
+                hashlib.sha512(
+                    b"dkg-coeff|" + material + k.to_bytes(4, "little")
+                ).digest(),
+                "little",
+            )
+            % bls.R
+            for k in range(threshold)
+        ]
+        if rng is None:
+            # never keep derivable material around in a real run
+            material = b""
+        self.commits = [bls.pk_of(a) for a in self._coeffs]
+        #: dealer -> validated commitment vector
+        self.peer_commits: Dict[int, List] = {self.index: self.commits}
+        #: dealer -> decrypted share for me
+        self.shares: Dict[int, int] = {
+            self.index: self._poly_at(self.index + 1)
+        }
+        #: dealers I complained about (bad/missing/undecryptable share)
+        self._my_complaints: Set[int] = set()
+        #: (dealer, complainer) pairs still awaiting a valid reveal
+        self._open_complaints: Set[Tuple[int, int]] = set()
+        self.disqualified: Set[int] = set()
+
+    # -- dealing ----------------------------------------------------------
+
+    def _poly_at(self, x: int) -> int:
+        acc = 0
+        for c in reversed(self._coeffs):
+            acc = (acc * x + c) % bls.R
+        return acc
+
+    def commitment_blob(self) -> bytes:
+        return b"".join(g2_encode(c) for c in self.commits)
+
+    def share_blob_for(self, j: int) -> Optional[bytes]:
+        """Encrypted share for participant j (None if j's identity key is
+        malformed — j will complain and this dealer must reveal)."""
+        if j == self.index:
+            return None
+        key = channel_key(self._seed, self._ids[j])
+        if key is None:
+            return None
+        return encrypt_share(key, self.index, j, self._poly_at(j + 1))
+
+    # -- receiving --------------------------------------------------------
+
+    def on_commitments(self, dealer: int, blob: bytes) -> bool:
+        """Validate + store dealer's commitment vector. Malformed vectors
+        disqualify immediately (commitments are broadcast, so everyone
+        reaches the same verdict)."""
+        if dealer == self.index or dealer in self.peer_commits:
+            return dealer in self.peer_commits
+        if len(blob) != self.t * _G2_BYTES:
+            self.disqualified.add(dealer)
+            return False
+        commits = []
+        for k in range(self.t):
+            p = g2_decode(blob[k * _G2_BYTES : (k + 1) * _G2_BYTES])
+            if p is None:
+                self.disqualified.add(dealer)
+                return False
+            commits.append(p)
+        self.peer_commits[dealer] = commits
+        return True
+
+    def _share_ok(self, dealer: int, x: int, s: int) -> bool:
+        commits = self.peer_commits.get(dealer)
+        if commits is None:
+            return False
+        return bls.pk_of(s) == _eval_commitments(commits, x)
+
+    def on_share(self, dealer: int, blob: bytes) -> bool:
+        """Decrypt + verify my share from dealer against its commitments."""
+        if dealer == self.index or dealer in self.shares:
+            return dealer in self.shares
+        key = channel_key(self._seed, self._ids[dealer])
+        s = (
+            decrypt_share(key, dealer, self.index, blob)
+            if key is not None
+            else None
+        )
+        if s is None or not self._share_ok(dealer, self.index + 1, s):
+            self._my_complaints.add(dealer)
+            return False
+        self.shares[dealer] = s
+        # A share can verify on a retransmit after an earlier failure
+        # (e.g. commitments arrived late): clear the provisional
+        # complaint, or the dealer would be forced into a needless
+        # public reveal of this node's share.
+        self._my_complaints.discard(dealer)
+        return True
+
+    # -- complaints -------------------------------------------------------
+
+    def complaints(self) -> List[int]:
+        """Dealers to publicly complain about: bad shares seen so far plus
+        dealers whose share (or commitments) never arrived. Call once the
+        dealing round is over (driver-level timeout)."""
+        missing = {
+            d
+            for d in range(self.n)
+            if d != self.index
+            and (d not in self.shares or d not in self.peer_commits)
+        }
+        self._my_complaints |= missing
+        return sorted(self._my_complaints)
+
+    def on_complaint(self, complainer: int, dealer: int) -> None:
+        if complainer == dealer or not 0 <= dealer < self.n:
+            return
+        if dealer in self.disqualified:
+            return
+        self._open_complaints.add((dealer, complainer))
+
+    def reveal_blob(self, complainer: int) -> bytes:
+        """Public reveal of complainer's share (this dealer answering a
+        complaint against itself)."""
+        return self._poly_at(complainer + 1).to_bytes(
+            _SCALAR_BYTES, "little"
+        )
+
+    def on_reveal(self, dealer: int, complainer: int, blob: bytes) -> None:
+        """A revealed share settles the complaint: valid -> complaint
+        cleared (and the complainer adopts it as its share if it was the
+        one complaining); invalid -> dealer disqualified."""
+        if (dealer, complainer) not in self._open_complaints:
+            return
+        if len(blob) != _SCALAR_BYTES:
+            self.disqualified.add(dealer)
+            return
+        s = int.from_bytes(blob, "little")
+        if self._share_ok(dealer, complainer + 1, s):
+            self._open_complaints.discard((dealer, complainer))
+            if complainer == self.index and dealer not in self.shares:
+                self.shares[dealer] = s
+                self._my_complaints.discard(dealer)
+        else:
+            self.disqualified.add(dealer)
+
+    # -- output -----------------------------------------------------------
+
+    def finalize(self) -> "DkgResult":
+        """Close the run: unanswered complaints disqualify, and the
+        qualified dealers' contributions combine into ThresholdKeys-shaped
+        output (share_sks holds only MY share; the rest are None)."""
+        for dealer, _ in list(self._open_complaints):
+            self.disqualified.add(dealer)
+        qualified = sorted(
+            d
+            for d in self.peer_commits
+            if d not in self.disqualified
+            and (d == self.index or d in self.shares)
+        )
+        if len(qualified) < self.t:
+            raise RuntimeError(
+                f"DKG failed: only {len(qualified)} qualified dealers "
+                f"(< threshold {self.t})"
+            )
+        share_sk = sum(self.shares[d] for d in qualified) % bls.R
+        # Commitments are homomorphic in the coefficients: summing the
+        # qualified vectors coefficient-wise once, then evaluating the
+        # combined polynomial per participant, replaces n*|Q| t-term
+        # MSMs with |Q|*t adds + n MSMs (identical output, ~|Q|x less
+        # work at committee scale).
+        combined = [None] * self.t
+        for d in qualified:
+            for k, c in enumerate(self.peer_commits[d]):
+                combined[k] = bls.g2_add(combined[k], c)
+        group_pk = combined[0]
+        share_pks = [
+            _eval_commitments(combined, i + 1) for i in range(self.n)
+        ]
+        return DkgResult(
+            index=self.index,
+            threshold=self.t,
+            qualified=tuple(qualified),
+            share_sk=share_sk,
+            share_pks=tuple(share_pks),
+            group_pk=group_pk,
+        )
+
+
+class DkgResult:
+    """One participant's DKG output, adaptable to ThresholdKeys."""
+
+    def __init__(self, index, threshold, qualified, share_sk, share_pks, group_pk):
+        self.index = index
+        self.threshold = threshold
+        self.qualified = qualified
+        self.share_sk = share_sk
+        self.share_pks = share_pks
+        self.group_pk = group_pk
+
+    def to_keys(self) -> ThresholdKeys:
+        """ThresholdKeys view for the existing coin machinery: share_sks
+        carries only this participant's secret (None elsewhere) — exactly
+        the dealerless property."""
+        sks: List[Optional[int]] = [None] * len(self.share_pks)
+        sks[self.index] = self.share_sk
+        return ThresholdKeys(
+            self.threshold, self.group_pk, self.share_pks, sks
+        )
+
+
+# ---------------------------------------------------------------------------
+# In-process driver (the message flow, honestly executed — the gRPC
+# runner in node.py routes these same blobs over the network)
+# ---------------------------------------------------------------------------
+
+
+def run_dkg(
+    n: int,
+    threshold: int,
+    identity_seeds: Sequence[bytes],
+    *,
+    byzantine: Optional[Dict[int, str]] = None,
+) -> List[DkgResult]:
+    """Full joint-Feldman round among n in-process participants.
+
+    ``byzantine`` maps dealer index -> fault: "bad_share" (corrupt every
+    outgoing share; the reveal is also bad, so disqualification follows),
+    "silent" (deal nothing). Returns each honest participant's result;
+    Byzantine participants get no result (None placeholders are skipped).
+    """
+    byzantine = byzantine or {}
+    pks = [ed.generate_keypair(s)[1] for s in identity_seeds]
+    sessions = [
+        DkgSession(i, n, threshold, identity_seeds[i], pks)
+        for i in range(n)
+    ]
+    # deal: broadcast commitments, direct-send shares
+    for d, sess in enumerate(sessions):
+        fault = byzantine.get(d)
+        if fault == "silent":
+            continue
+        cblob = sess.commitment_blob()
+        for j, other in enumerate(sessions):
+            if j == d:
+                continue
+            other.on_commitments(d, cblob)
+        for j, other in enumerate(sessions):
+            if j == d:
+                continue
+            blob = sess.share_blob_for(j)
+            if fault == "bad_share":
+                blob = bytes(len(blob))  # MAC fails -> undecryptable
+            other.on_share(d, blob)
+    # complain: broadcast
+    all_complaints = {i: sess.complaints() for i, sess in enumerate(sessions)}
+    for complainer, dealers in all_complaints.items():
+        for dealer in dealers:
+            for sess in sessions:
+                sess.on_complaint(complainer, dealer)
+    # reveal: each complained-against dealer answers publicly
+    for complainer, dealers in all_complaints.items():
+        for dealer in dealers:
+            fault = byzantine.get(dealer)
+            if fault == "silent":
+                continue  # no reveal -> finalize() disqualifies
+            blob = sessions[dealer].reveal_blob(complainer)
+            if fault == "bad_share":
+                blob = bytes(_SCALAR_BYTES)
+            for sess in sessions:
+                sess.on_reveal(dealer, complainer, blob)
+    return [
+        sessions[i].finalize() for i in range(n) if i not in byzantine
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Networked runner (gRPC BlobBus — the deployment path; VERDICT r4 #9)
+# ---------------------------------------------------------------------------
+
+
+def run_dkg_networked(
+    bus,
+    n: int,
+    threshold: int,
+    identity_seed: bytes,
+    identity_pks: Sequence[bytes],
+    *,
+    phase_timeout_s: float = 15.0,
+    poll_s: float = 0.05,
+) -> "DkgResult":
+    """One participant's joint-Feldman run over a
+    :class:`~dag_rider_tpu.transport.blobbus.BlobBus` (or anything with
+    its send/broadcast/recv surface).
+
+    Four timed phases — deal, complain, reveal, confirm — each barriered
+    on either hearing from every peer or the phase timeout, so silent or
+    partitioned dealers cost one timeout, not a deadlock, and end up
+    disqualified exactly as in the in-process driver. Retransmits the
+    deal once mid-phase to ride out one-shot send failures (the bus has
+    no retry of its own).
+
+    The CONFIRM phase makes key divergence a detected abort, not a
+    silent fork: timeout-based views can legitimately differ (a dealer
+    that crashed after reaching half the committee is qualified on one
+    side, complained-about on the other), so every participant
+    broadcasts a digest of its (qualified, group_pk, share_pks) and
+    requires every peer's digest to match — any mismatch or missing
+    confirmation raises, and the operators rerun the ceremony. A
+    Byzantine participant can therefore abort the run (deny the
+    ceremony) but never split it into two working committees with
+    different group keys."""
+    import time as _t
+
+    me = bus.index
+    sess = DkgSession(me, n, threshold, identity_seed, identity_pks)
+    others = [j for j in range(n) if j != me]
+
+    def _deal() -> None:
+        bus.broadcast("dkg_commit", sess.commitment_blob())
+        for j in others:
+            blob = sess.share_blob_for(j)
+            if blob is not None:
+                bus.send(j, "dkg_share", blob)
+
+    complaints_from: Dict[int, List[int]] = {}
+    reveals_seen: Set[Tuple[int, int]] = set()
+    confirms: Dict[int, bytes] = {}
+
+    def _pump() -> None:
+        for sender, kind, payload in bus.recv():
+            if not 0 <= sender < n or sender == me:
+                continue
+            if kind == "dkg_commit":
+                sess.on_commitments(sender, payload)
+            elif kind == "dkg_share":
+                sess.on_share(sender, payload)
+            elif kind == "dkg_confirm":
+                confirms.setdefault(sender, payload)
+            elif kind == "dkg_complaint":
+                dealers = [
+                    d
+                    for d in payload
+                    if d < n  # one byte per dealer index (n <= 255 here)
+                ]
+                complaints_from[sender] = dealers
+                for d in dealers:
+                    sess.on_complaint(sender, d)
+            elif kind == "dkg_reveal":
+                if len(payload) >= 4:
+                    (complainer,) = struct.unpack_from("<I", payload)
+                    sess.on_reveal(sender, complainer, payload[4:])
+                    reveals_seen.add((sender, complainer))
+
+    def _phase(done, timeout: float, *, mid=None) -> None:
+        deadline = _t.monotonic() + timeout
+        fired_mid = False
+        while _t.monotonic() < deadline:
+            _pump()
+            if done():
+                return
+            if mid is not None and not fired_mid and (
+                deadline - _t.monotonic() < timeout / 2
+            ):
+                fired_mid = True
+                mid()
+            bus.wait(poll_s)
+        _pump()
+
+    if n > 255:
+        raise ValueError("networked DKG complaint frame packs byte indices")
+    # phase 1: deal, and hear everyone's deal
+    _deal()
+    _phase(
+        lambda: all(
+            d in sess.peer_commits and d in sess.shares for d in others
+        ),
+        phase_timeout_s,
+        mid=_deal,  # one retransmit halfway through the window
+    )
+    # phase 2: broadcast complaints (always — peers barrier on hearing
+    # from everyone), hear everyone's
+    my_complaints = sess.complaints()
+    bus.broadcast("dkg_complaint", bytes(my_complaints))
+    _phase(
+        lambda: all(j in complaints_from for j in others),
+        phase_timeout_s,
+    )
+    # phase 3: answer complaints against me; hear expected reveals
+    expected: Set[Tuple[int, int]] = set()
+    for complainer, dealers in complaints_from.items():
+        for d in dealers:
+            if d == me:
+                bus.broadcast(
+                    "dkg_reveal",
+                    struct.pack("<I", complainer)
+                    + sess.reveal_blob(complainer),
+                )
+            elif d != complainer:
+                expected.add((d, complainer))
+    for d in my_complaints:
+        expected.add((d, me))
+    if expected:
+        _phase(lambda: expected <= reveals_seen, phase_timeout_s)
+    result = sess.finalize()
+    # phase 4: confirm — everyone must have derived the same key set
+    digest = hashlib.sha256(
+        b"dkg-confirm|"
+        + bytes(result.qualified)
+        + g2_encode(result.group_pk)
+        + b"".join(g2_encode(pk) for pk in result.share_pks)
+    ).digest()
+    bus.broadcast("dkg_confirm", digest)
+    _phase(lambda: all(j in confirms for j in others), phase_timeout_s)
+    bad = [
+        j
+        for j in others
+        if confirms.get(j) != digest
+    ]
+    if bad:
+        raise RuntimeError(
+            "DKG confirmation failed: participants "
+            f"{bad} missing or diverged — rerun the ceremony"
+        )
+    return result

@@ -78,12 +78,17 @@ def load_keys(blob: dict):
     from dag_rider_tpu.crypto import bls12381 as bls
 
     reg = KeyRegistry(tuple(bytes.fromhex(pk) for pk in blob["ed25519_public"]))
-    seeds = [bytes.fromhex(s) for s in blob["ed25519_seeds"]]
+    # DKG-produced files scrub other nodes' identity seeds (null)
+    seeds = [
+        bytes.fromhex(s) if s else None for s in blob["ed25519_seeds"]
+    ]
     coin_keys = th.ThresholdKeys(
         blob["threshold"],
         bls.g2_deserialize(bytes.fromhex(blob["bls_group_pk"])),
         [bls.g2_deserialize(bytes.fromhex(p)) for p in blob["bls_share_pks"]],
-        [int(sk, 16) for sk in blob["bls_share_sks"]],
+        # DKG-produced files carry only this node's secret (null
+        # elsewhere) — the dealerless property
+        [int(sk, 16) if sk else None for sk in blob["bls_share_sks"]],
     )
     return reg, seeds, coin_keys
 
@@ -385,6 +390,23 @@ def main(argv=None) -> int:
     kg.add_argument("--threshold", type=int, required=True)
     kg.add_argument("--seed", default="dagrider-committee")
     kg.add_argument("--out", required=True)
+    dk = sub.add_parser(
+        "dkg",
+        help="dealerless coin keygen: joint-Feldman DKG over gRPC "
+        "(replaces keygen's BLS dealer; Ed25519 identities from --keys "
+        "bootstrap the private share channels)",
+    )
+    dk.add_argument("--keys", required=True, help="keygen file (identities)")
+    dk.add_argument("--index", type=int, required=True)
+    dk.add_argument("--threshold", type=int, required=True)
+    dk.add_argument("--listen", required=True)
+    dk.add_argument(
+        "--peers",
+        required=True,
+        help='comma list "0=host:port,1=host:port,..." (all n participants)',
+    )
+    dk.add_argument("--out", required=True, help="per-node key file")
+    dk.add_argument("--timeout", type=float, default=15.0)
     rn = sub.add_parser("run", help="run one node until interrupted")
     rn.add_argument("--config", required=True)
     rn.add_argument("--duration", type=float, default=0, help="0 = forever")
@@ -396,6 +418,76 @@ def main(argv=None) -> int:
         with open(args.out, "w") as fh:
             json.dump(blob, fh, indent=1)
         print(f"wrote {args.out} (n={args.n}, threshold={args.threshold})")
+        return 0
+
+    if args.cmd == "dkg":
+        from dag_rider_tpu.crypto import bls12381 as bls
+        from dag_rider_tpu.crypto import dkg as dkg_mod
+        from dag_rider_tpu.transport.auth import FrameAuth
+        from dag_rider_tpu.transport.blobbus import BlobBus
+
+        with open(args.keys) as fh:
+            keyblob = json.load(fh)
+        my_seed = bytes.fromhex(keyblob["ed25519_seeds"][args.index])
+        pks = [bytes.fromhex(p) for p in keyblob["ed25519_public"]]
+        n = len(pks)
+        peers = {}
+        for part in args.peers.split(","):
+            k, _, addr = part.partition("=")
+            peers[int(k)] = addr
+        # Frame authentication from the Ed25519 identities themselves
+        # (pairwise ECDH keys — dkg.channel_key): sender indices on DKG
+        # traffic must be unforgeable or one Byzantine peer could stamp
+        # garbage commitments with an honest dealer's index and split
+        # the committee's qualified-set verdicts. No extra dealer
+        # secret involved — the identities ARE the PKI bootstrap.
+        pair_keys = {
+            j: dkg_mod.channel_key(my_seed, pks[j])
+            for j in range(n)
+            if j != args.index
+        }
+        if any(k is None for k in pair_keys.values()):
+            raise ValueError("malformed identity public key in --keys")
+        bus = BlobBus(
+            args.index, args.listen, peers,
+            auth=FrameAuth(args.index, pair_keys),
+        )
+        try:
+            res = dkg_mod.run_dkg_networked(
+                bus,
+                n,
+                args.threshold,
+                my_seed,
+                pks,
+                phase_timeout_s=args.timeout,
+            )
+        finally:
+            bus.close()
+        # same shape as keygen, but every secret list carries ONLY this
+        # node's entries — the dealerless property the DKG exists for
+        # (copying all n identity seeds into each out-file would hand
+        # any single file-holder every channel key and thereby the
+        # group secret)
+        out = dict(keyblob)
+        out["ed25519_seeds"] = [
+            keyblob["ed25519_seeds"][i] if i == args.index else None
+            for i in range(n)
+        ]
+        out["threshold"] = args.threshold
+        out["bls_group_pk"] = bls.g2_serialize(res.group_pk).hex()
+        out["bls_share_pks"] = [
+            bls.g2_serialize(pk).hex() for pk in res.share_pks
+        ]
+        out["bls_share_sks"] = [
+            hex(res.share_sk) if i == args.index else None for i in range(n)
+        ]
+        out["dkg_qualified"] = list(res.qualified)
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(
+            f"wrote {args.out} (dkg n={n}, threshold={args.threshold}, "
+            f"qualified={list(res.qualified)})"
+        )
         return 0
 
     with open(args.config) as fh:

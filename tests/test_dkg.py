@@ -1,0 +1,291 @@
+"""Joint-Feldman DKG (crypto/dkg.py) — the dealerless PKI for the
+threshold-BLS coin (reference TODO: process/process.go:388).
+
+The properties that matter: every honest participant derives the SAME
+group pk / share pks, only its own secret share, the output drives the
+existing threshold machinery (sign_share/aggregate/verify_group)
+unchanged, and Byzantine dealers (bad shares, silence, malformed or
+out-of-subgroup commitments) are disqualified without stalling the run.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dag_rider_tpu.crypto import bls12381 as bls
+from dag_rider_tpu.crypto import dkg, ed25519 as ed
+from dag_rider_tpu.crypto import threshold as th
+
+
+def _seeds(n: int):
+    return [bytes([i]) * 32 for i in range(n)]
+
+
+def test_honest_run_agrees_and_signs():
+    n, t = 4, 2
+    results = dkg.run_dkg(n, t, _seeds(n))
+    assert len(results) == n
+    r0 = results[0]
+    for r in results[1:]:
+        assert r.group_pk == r0.group_pk
+        assert r.share_pks == r0.share_pks
+        assert r.qualified == r0.qualified
+    # each share sk matches its public counterpart
+    for r in results:
+        assert bls.pk_of(r.share_sk) == r.share_pks[r.index]
+    # and t shares drive the EXISTING coin machinery end to end
+    wave = 7
+    shares = {r.index: th.sign_share(r.share_sk, wave) for r in results[:t]}
+    sigma = th.aggregate(shares, t)
+    assert sigma is not None
+    assert th.verify_group(r0.group_pk, wave, sigma)
+    # a different t-subset interpolates the SAME sigma (uniqueness)
+    shares2 = {r.index: th.sign_share(r.share_sk, wave) for r in results[-t:]}
+    assert th.aggregate(shares2, t) == sigma
+    # nobody's ThresholdKeys view carries anyone else's secret
+    keys0 = r0.to_keys()
+    assert keys0.share_sks[r0.index] == r0.share_sk
+    assert all(
+        sk is None for i, sk in enumerate(keys0.share_sks) if i != r0.index
+    )
+
+
+def test_bad_share_dealer_disqualified():
+    n, t = 4, 2
+    results = dkg.run_dkg(n, t, _seeds(n), byzantine={1: "bad_share"})
+    assert len(results) == 3
+    for r in results:
+        assert 1 not in r.qualified
+        assert set(r.qualified) == {0, 2, 3}
+    # the surviving quorum still signs
+    wave = 3
+    shares = {r.index: th.sign_share(r.share_sk, wave) for r in results[:t]}
+    sigma = th.aggregate(shares, t)
+    assert sigma and th.verify_group(results[0].group_pk, wave, sigma)
+
+
+def test_silent_dealer_disqualified():
+    n, t = 4, 2
+    results = dkg.run_dkg(n, t, _seeds(n), byzantine={2: "silent"})
+    for r in results:
+        assert 2 not in r.qualified
+
+
+def test_too_few_qualified_fails_loudly():
+    with pytest.raises(RuntimeError, match="qualified"):
+        dkg.run_dkg(
+            3, 3, _seeds(3), byzantine={0: "silent"}
+        )
+
+
+def test_malformed_commitments_disqualify():
+    n, t = 4, 2
+    pks = [ed.generate_keypair(s)[1] for s in _seeds(n)]
+    sess = dkg.DkgSession(0, n, t, _seeds(n)[0], pks)
+    # wrong length
+    assert not sess.on_commitments(1, b"\x00" * 10)
+    assert 1 in sess.disqualified
+    # right length, garbage bytes (off-curve)
+    assert not sess.on_commitments(2, b"\x01" * (t * 192))
+    assert 2 in sess.disqualified
+
+
+def test_unreduced_ladder_detects_non_subgroup_points():
+    """The [r]P == O membership primitive must NOT reduce its scalar mod
+    r (bls.g2_mul does, correctly for its r-torsion domain — using it
+    would accept every point). Validated on E(Fp), whose cofactor > 1
+    makes full-group points a square-root scan away: a random curve
+    point is (overwhelmingly) outside the r-subgroup and the ladder
+    must say so, while r-subgroup points and the scan point scaled by
+    the cofactor must pass."""
+    h1 = 0x396C8C005555E1568C00AAAB0000AAAB  # E(Fp) cofactor
+    found = None
+    for x in range(1, 200):
+        rhs = (pow(x, 3, bls.P) + 4) % bls.P
+        y = pow(rhs, (bls.P + 1) // 4, bls.P)
+        if y * y % bls.P == rhs:
+            p = (x, y)
+            if dkg._g1_mul_unreduced(bls.R, p) is not None:
+                found = p
+                break
+    assert found is not None, "scan found no out-of-subgroup E(Fp) point"
+    # clearing the cofactor lands it in the r-subgroup...
+    cleared = dkg._g1_mul_unreduced(h1, found)
+    assert dkg._g1_mul_unreduced(bls.R, cleared) is None
+    # ...and genuine subgroup points pass on both curves
+    assert dkg._g1_mul_unreduced(bls.R, bls.G1_GEN) is None
+    assert dkg._g2_mul_unreduced(bls.R, bls.G2_GEN) is None
+    assert dkg._g2_mul_unreduced(bls.R, bls.g2_mul(987654321)) is None
+
+
+def test_g2_decode_rejects_tampered_subgroup_blob():
+    """Flipping coordinate bytes of a valid commitment must fail the
+    twist-or-subgroup validation, never decode to a different point."""
+    blob = bytearray(dkg.g2_encode(bls.g2_mul(424242)))
+    for i in (0, 48, 96, 144, 191):
+        bad = bytearray(blob)
+        bad[i] ^= 0x01
+        assert dkg.g2_decode(bytes(bad)) is None
+
+
+def test_g2_roundtrip_and_subgroup_accepts_generator():
+    blob = dkg.g2_encode(bls.G2_GEN)
+    assert dkg.g2_decode(blob) == bls.G2_GEN
+    p = bls.g2_mul(12345)
+    assert dkg.g2_decode(dkg.g2_encode(p)) == p
+
+
+def test_channel_key_symmetry_and_share_encryption():
+    seeds = _seeds(3)
+    pks = [ed.generate_keypair(s)[1] for s in seeds]
+    k01 = dkg.channel_key(seeds[0], pks[1])
+    k10 = dkg.channel_key(seeds[1], pks[0])
+    assert k01 == k10 and k01 is not None
+    blob = dkg.encrypt_share(k01, 0, 1, 123456789)
+    assert dkg.decrypt_share(k10, 0, 1, blob) == 123456789
+    # direction is bound: decrypting with swapped roles fails
+    assert dkg.decrypt_share(k10, 1, 0, blob) is None
+    # tampering fails closed
+    bad = bytes([blob[0] ^ 1]) + blob[1:]
+    assert dkg.decrypt_share(k10, 0, 1, bad) is None
+
+
+def test_false_complaint_reveals_but_keeps_dealer():
+    """A Byzantine complainer cannot disqualify an honest dealer: the
+    reveal satisfies everyone and the dealer stays qualified."""
+    n, t = 4, 2
+    seeds = _seeds(n)
+    pks = [ed.generate_keypair(s)[1] for s in seeds]
+    sessions = [dkg.DkgSession(i, n, t, seeds[i], pks) for i in range(n)]
+    for d, s in enumerate(sessions):
+        cb = s.commitment_blob()
+        for j, o in enumerate(sessions):
+            if j != d:
+                o.on_commitments(d, cb)
+                o.on_share(d, s.share_blob_for(j))
+    # participant 3 falsely complains about dealer 0
+    for s in sessions:
+        s.on_complaint(3, 0)
+    reveal = sessions[0].reveal_blob(3)
+    for s in sessions:
+        s.on_reveal(0, 3, reveal)
+    results = [s.finalize() for s in sessions]
+    for r in results:
+        assert 0 in r.qualified
+    assert results[0].group_pk == results[3].group_pk
+
+
+def test_networked_dkg_over_grpc_agrees_and_signs():
+    """4 participants over real localhost gRPC (BlobBus): same group pk
+    everywhere, a t-subset signs, and the whole run is dealerless."""
+    import threading
+
+    from dag_rider_tpu.transport.blobbus import BlobBus
+
+    n, t = 4, 2
+    seeds = _seeds(n)
+    pks = [ed.generate_keypair(s)[1] for s in seeds]
+    buses = [BlobBus(i, "127.0.0.1:0", {}) for i in range(n)]
+    addrs = {i: f"127.0.0.1:{b.bound_port}" for i, b in enumerate(buses)}
+    for b in buses:
+        b._peers.update(addrs)
+    results = [None] * n
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = dkg.run_dkg_networked(
+                buses[i], n, t, seeds[i], pks, phase_timeout_s=30.0
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for th_ in threads:
+        th_.start()
+    for th_ in threads:
+        th_.join(timeout=60)
+    for b in buses:
+        b.close()
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    r0 = results[0]
+    assert r0.qualified == tuple(range(n))  # all honest: nobody dropped
+    for r in results[1:]:
+        assert r.group_pk == r0.group_pk and r.share_pks == r0.share_pks
+    wave = 11
+    shares = {r.index: th.sign_share(r.share_sk, wave) for r in results[:t]}
+    sigma = th.aggregate(shares, t)
+    assert sigma and th.verify_group(r0.group_pk, wave, sigma)
+
+
+def test_node_dkg_cli_roundtrip(tmp_path):
+    """The node-level flow: keygen (identities) -> per-node `dkg`
+    subcommand over gRPC -> per-node key files that load_keys accepts,
+    carrying ONLY that node's secret share."""
+    import threading
+
+    from dag_rider_tpu import node as node_mod
+
+    n, t = 4, 2
+    keys_path = str(tmp_path / "keys.json")
+    node_mod.main(
+        ["keygen", "--n", str(n), "--threshold", str(t), "--out", keys_path]
+    )
+    # pre-bind ports so every CLI invocation can name all peers
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    peer_arg = ",".join(f"{i}=127.0.0.1:{p}" for i, p in enumerate(ports))
+    outs = [str(tmp_path / f"node{i}.json") for i in range(n)]
+    errs = []
+
+    def run(i):
+        try:
+            node_mod.main(
+                [
+                    "dkg",
+                    "--keys", keys_path,
+                    "--index", str(i),
+                    "--threshold", str(t),
+                    "--listen", f"127.0.0.1:{ports[i]}",
+                    "--peers", peer_arg,
+                    "--out", outs[i],
+                    "--timeout", "30",
+                ]
+            )
+        except Exception as e:  # noqa: BLE001
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for th_ in threads:
+        th_.start()
+    for th_ in threads:
+        th_.join(timeout=90)
+    assert not errs, errs
+    import json as _json
+
+    loaded = [node_mod.load_keys(_json.load(open(o))) for o in outs]
+    _, _, ck0 = loaded[0]
+    for i, (_, _, ck) in enumerate(loaded):
+        assert ck.group_pk == ck0.group_pk
+        assert ck.share_pks == ck0.share_pks
+        # dealerless: only own secret present
+        assert ck.share_sks[i] is not None
+        assert all(
+            sk is None for j, sk in enumerate(ck.share_sks) if j != i
+        )
+    # the shares drive the coin machinery
+    wave = 5
+    shares = {
+        i: th.sign_share(loaded[i][2].share_sks[i], wave) for i in range(t)
+    }
+    sigma = th.aggregate(shares, t)
+    assert sigma and th.verify_group(ck0.group_pk, wave, sigma)
