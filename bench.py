@@ -1052,17 +1052,21 @@ def main() -> None:
         notes.append(f"probe attempt {attempt} failed: {tail}")
         _mark(f"outer: probe attempt {attempt} FAILED ({tail})")
         _relay_log(f"probe attempt {attempt} FAILED: {tail[:300]}")
+        banked = False
         if cpu_result is None and budget - elapsed() > cpu_reserve + 130.0:
             # bank a CPU number while waiting for the relay to recover
             cpu_timeout = max(60.0, min(cpu_reserve, budget - elapsed() - 100.0))
             _mark(f"outer: CPU fallback between probes (timeout {cpu_timeout:.0f}s)")
             cpu_result, ctail = run_cpu_fallback(cpu_timeout)
-            if cpu_result is None:
+            banked = cpu_result is not None
+            if not banked:
                 notes.append(f"cpu fallback: {ctail}")
-        else:
-            # Always pace failed probes — a probe that fails in <1s
-            # (e.g. ImportError) must not spin the loop spawning
-            # subprocesses until the budget floor is hit.
+        if not banked:
+            # Always pace failed probes — a probe (or fallback) that
+            # fails in <1s (e.g. ImportError of a base dep) must not
+            # spin the loop spawning subprocesses until the budget
+            # floor is hit. A successful fallback already consumed
+            # minutes, which is pacing enough.
             wait = min(30.0, max(5.0, budget - elapsed() - 110.0))
             _mark(f"outer: waiting {wait:.0f}s before next probe attempt")
             time.sleep(wait)

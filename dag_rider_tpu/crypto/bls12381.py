@@ -728,6 +728,14 @@ def _ec_mul_raw(ops: _Ops, k: int, p1):
     for bit in range(k.bit_length() - 1, -1, -1):
         if acc is not None:
             acc = _jac_double(ops, acc)
+            if acc is not None and acc[2] == zero:
+                # Doubling a point of even order lands on the Jacobian
+                # identity (Z == 0); collapse it to the None convention
+                # before a mixed addition could read the garbage X/Y.
+                # (Both BLS12-381 cofactors are odd, so this is a
+                # safety rail for arbitrary-point callers, not a path
+                # current inputs reach.)
+                acc = None
         if (k >> bit) & 1:
             if acc is None:
                 acc = (p1[0], p1[1], one)

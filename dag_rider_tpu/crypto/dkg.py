@@ -52,7 +52,7 @@ from dag_rider_tpu.crypto import ed25519 as ed
 from dag_rider_tpu.crypto.threshold import ThresholdKeys
 
 _SCALAR_BYTES = 32
-_G2_BYTES = 4 * 48  # uncompressed (x.a, x.b, y.a, y.b), 48B big-endian each
+_G2_BYTES = 4 * 48  # bls.g2_serialize: x.c1||x.c0||y.c1||y.c0, 48B BE each
 _CHAN_DOMAIN = b"dagrider-dkg-chan-v1|"
 _PAD_DOMAIN = b"dagrider-dkg-pad-v1|"
 _TAG_DOMAIN = b"dagrider-dkg-tag-v1|"
@@ -60,81 +60,32 @@ TAG_BYTES = 32
 
 
 # ---------------------------------------------------------------------------
-# G2 wire format (commitments). bls12381 has compressed G1 only; DKG
-# commitments are few (t per dealer, one-time), so uncompressed + full
-# validation beats implementing Fp2 square roots.
+# G2 wire format (commitments): the key-file format bls12381 already
+# defines (g2_serialize — uncompressed, range/curve/subgroup-validated on
+# read via the unreduced [r]P == O ladder), with two DKG-specific policy
+# differences at the boundary: junk returns None instead of raising
+# (Byzantine input is an expected verdict, not an exception), and the
+# identity encoding is refused (an identity commitment is either a
+# zero-polynomial dealer — a useless no-op contribution — or malformed).
 # ---------------------------------------------------------------------------
 
 
 def g2_encode(p) -> bytes:
     if p is None:
         raise ValueError("cannot encode the identity commitment")
-    (xa, xb), (ya, yb) = p
-    return b"".join(v.to_bytes(48, "big") for v in (xa, xb, ya, yb))
-
-
-def _mul_unreduced(ops, zero, one, k: int, p):
-    """[k]P WITHOUT reducing k mod r — bls.g1_mul/g2_mul (correctly, for
-    their r-torsion inputs) map k == r to the identity before touching
-    P, so they cannot implement the [r]P == O membership test this file
-    needs. Plain Jacobian double-and-add; None is the identity
-    throughout (and a Z == 0 accumulator — doubling a point of even
-    order — collapses back to None before it can poison a mixed
-    addition)."""
-    acc = None
-    for bit in range(k.bit_length() - 1, -1, -1):
-        if acc is not None:
-            acc = bls._jac_double(ops, acc)
-            if acc is not None and acc[2] == zero:
-                acc = None
-        if (k >> bit) & 1:
-            acc = (
-                (p[0], p[1], one)
-                if acc is None
-                else bls._jac_madd(ops, acc, p, zero)
-            )
-    return bls._jac_to_affine(ops, acc, zero)
-
-
-def _g2_mul_unreduced(k: int, p):
-    return _mul_unreduced(bls._FP2_OPS, bls.FP2_ZERO, bls.FP2_ONE, k, p)
-
-
-def _g1_mul_unreduced(k: int, p):
-    """Same ladder over E(Fp) — exists so the membership primitive can be
-    exercised against easy-to-construct non-subgroup points (E(Fp) has
-    cofactor > 1 and its full-group points are a square-root scan away,
-    while the twist's are behind an Fp2 Tonelli-Shanks)."""
-    return _mul_unreduced(bls._FP_OPS, 0, 1, k, p)
+    return bls.g2_serialize(p)
 
 
 def g2_decode(data: bytes):
-    """Decode + validate one uncompressed G2 point.
-
-    Returns None on anything malformed: wrong length, coordinates >= p,
-    off the twist, or outside the r-order subgroup (the cofactor of the
-    twist is large; an adversarial commitment in a small subgroup would
-    corrupt everyone's derived share_pks undetectably, so the [r]P == O
-    check is not optional)."""
-    if len(data) != _G2_BYTES:
+    """None on anything malformed: wrong length, out-of-range
+    coordinates, off the twist, outside the r-order subgroup (an
+    adversarial small-subgroup commitment would corrupt everyone's
+    derived share_pks undetectably), or the identity encoding."""
+    try:
+        p = bls.g2_deserialize(data)
+    except ValueError:
         return None
-    xa, xb, ya, yb = (
-        int.from_bytes(data[i * 48 : (i + 1) * 48], "big") for i in range(4)
-    )
-    if max(xa, xb, ya, yb) >= bls.P:
-        return None
-    x, y = (xa, xb), (ya, yb)
-    # twist equation: y^2 = x^3 + 4(u+1)
-    lhs = bls.fp2_sqr(y)
-    rhs = bls.fp2_add(
-        bls.fp2_mul(bls.fp2_sqr(x), x), bls.fp2_scalar((4, 4), 1)
-    )
-    if lhs != rhs:
-        return None
-    p = (x, y)
-    if _g2_mul_unreduced(bls.R, p) is not None:  # subgroup membership
-        return None
-    return p
+    return p  # g2_deserialize returns None only for the identity
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +186,11 @@ class DkgSession:
         self.t = threshold
         self._seed = identity_seed
         self._ids = list(identity_pks)
-        # polynomial: rng is for tests only; deployments use os.urandom
+        # Polynomial coefficients: rng is for tests only; deployments use
+        # os.urandom. (The coefficients themselves necessarily live for
+        # the whole session — reveal_blob re-evaluates the polynomial —
+        # so there is no secret-scrubbing story here beyond process
+        # lifetime.)
         material = rng if rng is not None else os.urandom(64)
         self._coeffs = [
             int.from_bytes(
@@ -247,9 +202,6 @@ class DkgSession:
             % bls.R
             for k in range(threshold)
         ]
-        if rng is None:
-            # never keep derivable material around in a real run
-            material = b""
         self.commits = [bls.pk_of(a) for a in self._coeffs]
         #: dealer -> validated commitment vector
         self.peer_commits: Dict[int, List] = {self.index: self.commits}
@@ -559,7 +511,6 @@ def run_dkg_networked(
                 bus.send(j, "dkg_share", blob)
 
     complaints_from: Dict[int, List[int]] = {}
-    reveals_seen: Set[Tuple[int, int]] = set()
     confirms: Dict[int, bytes] = {}
 
     def _pump() -> None:
@@ -585,7 +536,6 @@ def run_dkg_networked(
                 if len(payload) >= 4:
                     (complainer,) = struct.unpack_from("<I", payload)
                     sess.on_reveal(sender, complainer, payload[4:])
-                    reveals_seen.add((sender, complainer))
 
     def _phase(done, timeout: float, *, mid=None) -> None:
         deadline = _t.monotonic() + timeout
@@ -614,36 +564,63 @@ def run_dkg_networked(
         mid=_deal,  # one retransmit halfway through the window
     )
     # phase 2: broadcast complaints (always — peers barrier on hearing
-    # from everyone), hear everyone's
+    # from everyone), hear everyone's. The broadcast must ALSO be fed to
+    # our own session: _pump filters sender == me, and on_reveal only
+    # accepts reveals for complaints registered via on_complaint — a
+    # complainer that skipped self-registration would reject the very
+    # reveal it waited for (round-5 review: one false complaint aborted
+    # every networked ceremony while the in-process driver — which
+    # delivers to all sessions including the sender's — passed).
     my_complaints = sess.complaints()
+    for d in my_complaints:
+        sess.on_complaint(me, d)
     bus.broadcast("dkg_complaint", bytes(my_complaints))
     _phase(
         lambda: all(j in complaints_from for j in others),
         phase_timeout_s,
     )
-    # phase 3: answer complaints against me; hear expected reveals
-    expected: Set[Tuple[int, int]] = set()
-    for complainer, dealers in complaints_from.items():
-        for d in dealers:
-            if d == me:
-                bus.broadcast(
-                    "dkg_reveal",
-                    struct.pack("<I", complainer)
-                    + sess.reveal_blob(complainer),
-                )
-            elif d != complainer:
-                expected.add((d, complainer))
-    for d in my_complaints:
-        expected.add((d, me))
-    if expected:
-        _phase(lambda: expected <= reveals_seen, phase_timeout_s)
+
+    # phase 3: answer complaints against me; wait until every open
+    # complaint against OTHER dealers is settled (valid reveal clears
+    # the entry; invalid reveal marks the dealer disqualified) or the
+    # window closes. Driven off the session's own _open_complaints —
+    # the authoritative set — not a complaints_from snapshot, which a
+    # duplicate/forged complaint frame can overwrite racily.
+    def _reveal(complainer: int) -> None:
+        blob = sess.reveal_blob(complainer)
+        bus.broadcast(
+            "dkg_reveal", struct.pack("<I", complainer) + blob
+        )
+        # self-feed, or our own open (me, complainer) entry would
+        # never clear and finalize() would self-disqualify us
+        sess.on_reveal(me, complainer, blob)
+
+    for dealer, complainer in list(sess._open_complaints):
+        if dealer == me:
+            _reveal(complainer)
+
+    def _reveals_settled() -> bool:
+        return all(
+            d in sess.disqualified
+            for d, _ in sess._open_complaints
+            if d != me
+        )
+
+    _phase(_reveals_settled, phase_timeout_s)
+    # answer complaints that arrived during phase 3 before closing (a
+    # residual race here means divergent views — caught by CONFIRM)
+    for dealer, complainer in list(sess._open_complaints):
+        if dealer == me:
+            _reveal(complainer)
     result = sess.finalize()
     # phase 4: confirm — everyone must have derived the same key set
     digest = hashlib.sha256(
         b"dkg-confirm|"
         + bytes(result.qualified)
-        + g2_encode(result.group_pk)
-        + b"".join(g2_encode(pk) for pk in result.share_pks)
+        # g2_serialize, not g2_encode: the digest must never raise, and
+        # it encodes a (negligible-probability) identity as zeros
+        + bls.g2_serialize(result.group_pk)
+        + b"".join(bls.g2_serialize(pk) for pk in result.share_pks)
     ).digest()
     bus.broadcast("dkg_confirm", digest)
     _phase(lambda: all(j in confirms for j in others), phase_timeout_s)

@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -390,6 +391,14 @@ def main(argv=None) -> int:
     kg.add_argument("--threshold", type=int, required=True)
     kg.add_argument("--seed", default="dagrider-committee")
     kg.add_argument("--out", required=True)
+    kg.add_argument(
+        "--per-node-dir",
+        default=None,
+        help="also write <dir>/node<i>-identity.json per node, each "
+        "holding ONLY that node's secrets (the files a DKG ceremony "
+        "should start from — a combined file holding every seed lets "
+        "any single holder decrypt all DKG share traffic)",
+    )
     dk = sub.add_parser(
         "dkg",
         help="dealerless coin keygen: joint-Feldman DKG over gRPC "
@@ -418,6 +427,27 @@ def main(argv=None) -> int:
         with open(args.out, "w") as fh:
             json.dump(blob, fh, indent=1)
         print(f"wrote {args.out} (n={args.n}, threshold={args.threshold})")
+        if args.per_node_dir:
+            os.makedirs(args.per_node_dir, exist_ok=True)
+            for i in range(args.n):
+                per = dict(blob)
+                per["ed25519_seeds"] = [
+                    s if j == i else None
+                    for j, s in enumerate(blob["ed25519_seeds"])
+                ]
+                per["bls_share_sks"] = [
+                    sk if j == i else None
+                    for j, sk in enumerate(blob["bls_share_sks"])
+                ]
+                path = os.path.join(
+                    args.per_node_dir, f"node{i}-identity.json"
+                )
+                with open(path, "w") as fh:
+                    json.dump(per, fh, indent=1)
+            print(
+                f"wrote {args.n} per-node identity files under "
+                f"{args.per_node_dir} (each holds only its own secrets)"
+            )
         return 0
 
     if args.cmd == "dkg":

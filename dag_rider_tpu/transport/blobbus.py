@@ -126,6 +126,8 @@ class BlobBus:
             return self._stubs[peer]
 
     def send(self, peer: int, kind: str, payload: bytes) -> bool:
+        if peer not in self._peers or peer == self.index:
+            return False  # incomplete peer table: a verdict, not a crash
         body = _frame(self.index, kind, payload)
         if self._auth is not None:
             body += self._auth.tag(peer, body)

@@ -1,0 +1,54 @@
+#!/bin/bash
+# One-shot on-chip capture for a healthy relay window (round-4 VERDICT
+# #1-#5,#7: the full on-chip queue). Runs the measure stage directly
+# with a generous budget and every rung enabled, then stamps the result
+# into BENCH_local_tpu.json. Run from the repo root:
+#
+#   bash scripts/tpu_capture.sh [budget_seconds]
+#
+# The driver's own bench run keeps its 540 s budget; this script is the
+# builder-local capture with room for sim256 + sim256_sync + verify1024
+# + msm1024 + the Pallas probes.
+set -u
+cd "$(dirname "$0")/.."
+BUDGET="${1:-1500}"
+
+echo "probing relay first (90 s timeout)..."
+if ! timeout 90 python -c "
+import jax
+print('relay OK:', jax.devices())
+"; then
+    echo "relay did not answer; aborting capture" >&2
+    exit 1
+fi
+
+OUT="/tmp/tpu_capture_$$.json"
+LOG="/tmp/tpu_capture_$$.log"
+env DAGRIDER_BENCH_STAGE=measure \
+    DAGRIDER_BENCH_SECONDS="$BUDGET" \
+    DAGRIDER_BENCH_SIM_S=60 \
+    DAGRIDER_BENCH_SIM256_S=90 \
+    DAGRIDER_BENCH_SIM256_SYNC_S=40 \
+    DAGRIDER_BENCH_HOSTSIM_S=12 \
+    DAGRIDER_BENCH_HOSTSIM256_S=12 \
+    timeout $((BUDGET + 120)) python -u bench.py > "$OUT" 2> "$LOG"
+rc=$?
+tail -5 "$LOG" >&2
+if [ $rc -ne 0 ] || ! tail -1 "$OUT" | python -c "
+import json, sys
+d = json.loads(sys.stdin.read())
+assert d.get('value', 0) > 0, d
+print('value', d['value'], d['unit'], 'backend', d['backend'])
+"; then
+    echo "capture failed (rc=$rc); partial output in $OUT, log in $LOG" >&2
+    exit 1
+fi
+tail -1 "$OUT" | python -c "
+import datetime, json, sys
+d = json.loads(sys.stdin.read())
+d['captured_at'] = datetime.datetime.now().isoformat(timespec='seconds')
+d['round'] = 5
+json.dump(d, open('BENCH_local_tpu.json', 'w'), indent=1)
+print('wrote BENCH_local_tpu.json:', d['value'], d['unit'],
+      'on', d.get('device_kind'))
+"

@@ -91,31 +91,41 @@ def test_malformed_commitments_disqualify():
 
 
 def test_unreduced_ladder_detects_non_subgroup_points():
-    """The [r]P == O membership primitive must NOT reduce its scalar mod
-    r (bls.g2_mul does, correctly for its r-torsion domain — using it
-    would accept every point). Validated on E(Fp), whose cofactor > 1
-    makes full-group points a square-root scan away: a random curve
-    point is (overwhelmingly) outside the r-subgroup and the ladder
-    must say so, while r-subgroup points and the scan point scaled by
-    the cofactor must pass."""
+    """The [r]P == O membership primitive (bls._ec_mul_raw — the same
+    ladder g2_deserialize/g2_decode gate commitments through) must NOT
+    reduce its scalar mod r (bls.g2_mul does, correctly for its
+    r-torsion domain — using it would accept every point). Validated on
+    E(Fp), whose cofactor > 1 makes full-group points a square-root
+    scan away: a random curve point is (overwhelmingly) outside the
+    r-subgroup and the ladder must say so, while r-subgroup points and
+    the scan point scaled by the cofactor must pass."""
     h1 = 0x396C8C005555E1568C00AAAB0000AAAB  # E(Fp) cofactor
+
+    def g1_raw(k, p):
+        return bls._ec_mul_raw(bls._FP_OPS, k, p)
+
+    def g2_raw(k, p):
+        return bls._ec_mul_raw(bls._FP2_OPS, k, p)
+
     found = None
     for x in range(1, 200):
         rhs = (pow(x, 3, bls.P) + 4) % bls.P
         y = pow(rhs, (bls.P + 1) // 4, bls.P)
         if y * y % bls.P == rhs:
             p = (x, y)
-            if dkg._g1_mul_unreduced(bls.R, p) is not None:
+            if g1_raw(bls.R, p) is not None:
                 found = p
                 break
     assert found is not None, "scan found no out-of-subgroup E(Fp) point"
     # clearing the cofactor lands it in the r-subgroup...
-    cleared = dkg._g1_mul_unreduced(h1, found)
-    assert dkg._g1_mul_unreduced(bls.R, cleared) is None
+    cleared = g1_raw(h1, found)
+    assert g1_raw(bls.R, cleared) is None
     # ...and genuine subgroup points pass on both curves
-    assert dkg._g1_mul_unreduced(bls.R, bls.G1_GEN) is None
-    assert dkg._g2_mul_unreduced(bls.R, bls.G2_GEN) is None
-    assert dkg._g2_mul_unreduced(bls.R, bls.g2_mul(987654321)) is None
+    assert g1_raw(bls.R, bls.G1_GEN) is None
+    assert g2_raw(bls.R, bls.G2_GEN) is None
+    assert g2_raw(bls.R, bls.g2_mul(987654321)) is None
+    # the identity encoding is refused as a commitment
+    assert dkg.g2_decode(bytes(192)) is None
 
 
 def test_g2_decode_rejects_tampered_subgroup_blob():
@@ -229,9 +239,21 @@ def test_node_dkg_cli_roundtrip(tmp_path):
 
     n, t = 4, 2
     keys_path = str(tmp_path / "keys.json")
+    ident_dir = str(tmp_path / "identities")
     node_mod.main(
-        ["keygen", "--n", str(n), "--threshold", str(t), "--out", keys_path]
+        [
+            "keygen", "--n", str(n), "--threshold", str(t),
+            "--out", keys_path, "--per-node-dir", ident_dir,
+        ]
     )
+    # the recommended ceremony input: per-node identity files holding
+    # ONLY that node's seed (a combined all-seeds file would let any
+    # single holder decrypt every DKG share on the wire)
+    import json as _json
+
+    ident0 = _json.load(open(f"{ident_dir}/node0-identity.json"))
+    assert ident0["ed25519_seeds"][0] and ident0["ed25519_seeds"][1] is None
+    assert ident0["bls_share_sks"][1] is None
     # pre-bind ports so every CLI invocation can name all peers
     import socket
 
@@ -252,7 +274,7 @@ def test_node_dkg_cli_roundtrip(tmp_path):
             node_mod.main(
                 [
                     "dkg",
-                    "--keys", keys_path,
+                    "--keys", f"{ident_dir}/node{i}-identity.json",
                     "--index", str(i),
                     "--threshold", str(t),
                     "--listen", f"127.0.0.1:{ports[i]}",
@@ -270,8 +292,6 @@ def test_node_dkg_cli_roundtrip(tmp_path):
     for th_ in threads:
         th_.join(timeout=90)
     assert not errs, errs
-    import json as _json
-
     loaded = [node_mod.load_keys(_json.load(open(o))) for o in outs]
     _, _, ck0 = loaded[0]
     for i, (_, _, ck) in enumerate(loaded):
@@ -289,3 +309,62 @@ def test_node_dkg_cli_roundtrip(tmp_path):
     }
     sigma = th.aggregate(shares, t)
     assert sigma and th.verify_group(ck0.group_pk, wave, sigma)
+
+
+def test_networked_dkg_survives_false_complaint():
+    """Round-5 review repro: one forged complaint against an honest
+    dealer previously aborted every networked ceremony (the runner never
+    fed its own complaint/reveal broadcasts into its own session). Now
+    the dealer reveals, everyone settles, and the ceremony succeeds with
+    the dealer still qualified."""
+    import threading
+
+    from dag_rider_tpu.transport import blobbus
+    from dag_rider_tpu.transport.blobbus import BlobBus
+
+    n, t = 3, 2
+    seeds = _seeds(n)
+    pks = [ed.generate_keypair(s)[1] for s in seeds]
+    buses = [BlobBus(i, "127.0.0.1:0", {}) for i in range(n)]
+    addrs = {i: f"127.0.0.1:{b.bound_port}" for i, b in enumerate(buses)}
+    for b in buses:
+        b._peers.update(addrs)
+    # inject a forged complaint "from node 2" naming dealer 0 BEFORE the
+    # ceremony starts — it sits in node 0/1's inboxes and is consumed in
+    # their first pump (no auth on this bus, so the sender stamp is
+    # trusted: exactly the Byzantine frame the recovery round must eat)
+    forged = blobbus._frame(2, "dkg_complaint", bytes([0]))
+    import grpc as _grpc
+
+    for target in (0, 1):
+        chan = _grpc.insecure_channel(addrs[target])
+        chan.unary_unary(
+            "/dagrider.BlobBus/Post",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(forged, timeout=5)
+        chan.close()
+
+    results = [None] * n
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = dkg.run_dkg_networked(
+                buses[i], n, t, seeds[i], pks, phase_timeout_s=30.0
+            )
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for th_ in threads:
+        th_.start()
+    for th_ in threads:
+        th_.join(timeout=120)
+    for b in buses:
+        b.close()
+    assert not errors, errors
+    r0 = results[0]
+    assert r0.qualified == tuple(range(n))  # dealer 0 survives
+    for r in results[1:]:
+        assert r.group_pk == r0.group_pk and r.share_pks == r0.share_pks
