@@ -969,13 +969,22 @@ class Process:
         return self.dag.get(VertexID(self.cfg.wave_round(wave, 1), src))
 
     def _strong_reach_count(self, r_hi: int, r_lo: int, leader_src: int) -> int:
-        """|{v in dag[r_hi] : strong path v -> leader}| via the dense-mirror
-        matmul chain — host twin of ops.dag_kernels.wave_commit_votes."""
+        """|{v in dag[r_hi] : strong path v -> leader}| — host twin of
+        ops.dag_kernels.wave_commit_votes.
+
+        Back-propagates a reach VECTOR up the wave instead of chaining
+        n x n bool matmuls: only the leader's column of the full reach
+        matrix is ever consumed, so each level is one masked column
+        selection + row-OR (~n^2 bit ops) rather than an n^3 matmul —
+        at n=256 this was ~4.5 ms per wave try, ~10% of the host loop."""
         base = self.dag.base_round
-        reach = np.eye(self.cfg.n, dtype=bool)
-        for r in range(r_hi, r_lo, -1):
-            reach = reach @ self.dag.strong[r - base]
-        votes = reach[:, leader_src] & self.dag.exists[r_hi - base]
+        if r_hi == r_lo:
+            return int(self.dag.exists[r_hi - base, leader_src])
+        # vec[i] = True iff (r, i) strong-reaches the leader at r_lo
+        vec = self.dag.strong[r_lo + 1 - base][:, leader_src]
+        for r in range(r_lo + 2, r_hi + 1):
+            vec = self.dag.strong[r - base][:, vec].any(axis=1)
+        votes = vec & self.dag.exists[r_hi - base]
         return int(votes.sum())
 
     # ------------------------------------------------------------------

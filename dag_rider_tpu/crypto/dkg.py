@@ -213,6 +213,10 @@ class DkgSession:
         self._my_complaints: Set[int] = set()
         #: (dealer, complainer) pairs still awaiting a valid reveal
         self._open_complaints: Set[Tuple[int, int]] = set()
+        #: frames that outraced their dealer's commitments, stashed
+        #: judgement-free until on_commitments replays them
+        self._pending_shares: Dict[int, int] = {}
+        self._pending_reveals: Dict[Tuple[int, int], bytes] = {}
         self.disqualified: Set[int] = set()
 
     # -- dealing ----------------------------------------------------------
@@ -241,7 +245,9 @@ class DkgSession:
     def on_commitments(self, dealer: int, blob: bytes) -> bool:
         """Validate + store dealer's commitment vector. Malformed vectors
         disqualify immediately (commitments are broadcast, so everyone
-        reaches the same verdict)."""
+        reaches the same verdict). Shares/reveals that arrived BEFORE the
+        commitments (separate frames race over a real network) were
+        stashed judgement-free and are re-judged now."""
         if dealer == self.index or dealer in self.peer_commits:
             return dealer in self.peer_commits
         if len(blob) != self.t * _G2_BYTES:
@@ -255,6 +261,17 @@ class DkgSession:
                 return False
             commits.append(p)
         self.peer_commits[dealer] = commits
+        s = self._pending_shares.pop(dealer, None)
+        if s is not None and dealer not in self.shares:
+            if self._share_ok(dealer, self.index + 1, s):
+                self.shares[dealer] = s
+                self._my_complaints.discard(dealer)
+            else:
+                self._my_complaints.add(dealer)
+        for (d, complainer), blob_r in list(self._pending_reveals.items()):
+            if d == dealer:
+                del self._pending_reveals[(d, complainer)]
+                self.on_reveal(d, complainer, blob_r)
         return True
 
     def _share_ok(self, dealer: int, x: int, s: int) -> bool:
@@ -264,7 +281,14 @@ class DkgSession:
         return bls.pk_of(s) == _eval_commitments(commits, x)
 
     def on_share(self, dealer: int, blob: bytes) -> bool:
-        """Decrypt + verify my share from dealer against its commitments."""
+        """Decrypt + verify my share from dealer against its commitments.
+
+        A share whose dealer's commitments have not arrived yet cannot
+        be judged: it is stashed (no complaint, no verdict) and re-judged
+        when the commitments land — the two frames race over a real
+        network, and misjudging the ordering as dealer fault would force
+        a needless public reveal (or, pre-round-5-fix, a divergent
+        disqualification)."""
         if dealer == self.index or dealer in self.shares:
             return dealer in self.shares
         key = channel_key(self._seed, self._ids[dealer])
@@ -273,7 +297,13 @@ class DkgSession:
             if key is not None
             else None
         )
-        if s is None or not self._share_ok(dealer, self.index + 1, s):
+        if s is None:
+            self._my_complaints.add(dealer)
+            return False
+        if dealer not in self.peer_commits:
+            self._pending_shares[dealer] = s
+            return False
+        if not self._share_ok(dealer, self.index + 1, s):
             self._my_complaints.add(dealer)
             return False
         self.shares[dealer] = s
@@ -316,11 +346,16 @@ class DkgSession:
     def on_reveal(self, dealer: int, complainer: int, blob: bytes) -> None:
         """A revealed share settles the complaint: valid -> complaint
         cleared (and the complainer adopts it as its share if it was the
-        one complaining); invalid -> dealer disqualified."""
+        one complaining); invalid -> dealer disqualified. A reveal that
+        outraces the dealer's commitments is stashed judgement-free and
+        replayed by on_commitments."""
         if (dealer, complainer) not in self._open_complaints:
             return
         if len(blob) != _SCALAR_BYTES:
             self.disqualified.add(dealer)
+            return
+        if dealer not in self.peer_commits:
+            self._pending_reveals[(dealer, complainer)] = bytes(blob)
             return
         s = int.from_bytes(blob, "little")
         if self._share_ok(dealer, complainer + 1, s):
@@ -587,6 +622,10 @@ def run_dkg_networked(
     # the authoritative set — not a complaints_from snapshot, which a
     # duplicate/forged complaint frame can overwrite racily.
     def _reveal(complainer: int) -> None:
+        # the complaint may be about MISSING commitments (the complainer
+        # started late and lost the deal broadcast): re-broadcast them
+        # first so the reveal that follows can actually be judged
+        bus.broadcast("dkg_commit", sess.commitment_blob())
         blob = sess.reveal_blob(complainer)
         bus.broadcast(
             "dkg_reveal", struct.pack("<I", complainer) + blob

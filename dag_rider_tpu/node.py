@@ -55,8 +55,20 @@ from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
 # keygen
 # ----------------------------------------------------------------------
 
-def generate_keys(n: int, threshold: int, seed: str = "dagrider-committee") -> dict:
-    """Committee key material as one JSON-serializable dict."""
+def generate_keys(
+    n: int, threshold: int, seed: Optional[str] = None
+) -> dict:
+    """Committee key material as one JSON-serializable dict.
+
+    ``seed`` pins the material deterministically — tests/fixtures only.
+    Left unset (the CLI default), a fresh 256-bit secret is drawn from
+    os.urandom: a guessable seed makes every identity seed publicly
+    re-derivable, which in turn voids the DKG's share confidentiality
+    (anyone can compute the pairwise channel keys offline)."""
+    if seed is None:
+        import secrets
+
+        seed = secrets.token_hex(32)
     reg, seeds = KeyRegistry.generate(n, seed_prefix=seed.encode() + b"|ed|")
     coin_keys = th.ThresholdKeys.generate(n, threshold, seed=seed.encode())
     from dag_rider_tpu.crypto import bls12381 as bls
@@ -72,6 +84,18 @@ def generate_keys(n: int, threshold: int, seed: str = "dagrider-committee") -> d
         ],
         "bls_share_sks": [hex(sk) for sk in coin_keys.share_sks],
     }
+
+
+def _dump_secret_file(path: str, blob: dict) -> None:
+    """Write a key file owner-readable only (0600): these carry Ed25519
+    seeds / BLS share secrets, and a world-readable default would hand
+    any local user the node's DKG channel keys."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    # open()'s mode only applies on CREATION — overwriting a
+    # pre-existing world-readable file must tighten it too
+    os.fchmod(fd, 0o600)
+    with os.fdopen(fd, "w") as fh:
+        json.dump(blob, fh, indent=1)
 
 
 def load_keys(blob: dict):
@@ -389,8 +413,20 @@ def main(argv=None) -> int:
     kg = sub.add_parser("keygen", help="generate committee key material")
     kg.add_argument("--n", type=int, required=True)
     kg.add_argument("--threshold", type=int, required=True)
-    kg.add_argument("--seed", default="dagrider-committee")
-    kg.add_argument("--out", required=True)
+    kg.add_argument(
+        "--seed",
+        default=None,
+        help="deterministic committee seed — tests only; default draws "
+        "fresh randomness (a guessable seed voids DKG confidentiality)",
+    )
+    kg.add_argument(
+        "--out",
+        default=None,
+        help="combined key file holding EVERY node's secrets (dealer "
+        "deployments / tests). Omit it when --per-node-dir is given: "
+        "for a DKG ceremony the combined file is exactly the "
+        "single-holder-decrypts-everything artifact to avoid",
+    )
     kg.add_argument(
         "--per-node-dir",
         default=None,
@@ -423,10 +459,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.cmd == "keygen":
+        if not args.out and not args.per_node_dir:
+            raise SystemExit("keygen needs --out and/or --per-node-dir")
         blob = generate_keys(args.n, args.threshold, args.seed)
-        with open(args.out, "w") as fh:
-            json.dump(blob, fh, indent=1)
-        print(f"wrote {args.out} (n={args.n}, threshold={args.threshold})")
+        if args.out:
+            _dump_secret_file(args.out, blob)
+            print(
+                f"wrote {args.out} (n={args.n}, threshold={args.threshold})"
+            )
         if args.per_node_dir:
             os.makedirs(args.per_node_dir, exist_ok=True)
             for i in range(args.n):
@@ -442,8 +482,7 @@ def main(argv=None) -> int:
                 path = os.path.join(
                     args.per_node_dir, f"node{i}-identity.json"
                 )
-                with open(path, "w") as fh:
-                    json.dump(per, fh, indent=1)
+                _dump_secret_file(path, per)
             print(
                 f"wrote {args.n} per-node identity files under "
                 f"{args.per_node_dir} (each holds only its own secrets)"
@@ -512,8 +551,7 @@ def main(argv=None) -> int:
             hex(res.share_sk) if i == args.index else None for i in range(n)
         ]
         out["dkg_qualified"] = list(res.qualified)
-        with open(args.out, "w") as fh:
-            json.dump(out, fh, indent=1)
+        _dump_secret_file(args.out, out)
         print(
             f"wrote {args.out} (dkg n={n}, threshold={args.threshold}, "
             f"qualified={list(res.qualified)})"

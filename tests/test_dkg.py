@@ -283,8 +283,10 @@ def test_node_dkg_cli_roundtrip(tmp_path):
                     "--timeout", "30",
                 ]
             )
-        except Exception as e:  # noqa: BLE001
-            errs.append((i, e))
+        except Exception:  # noqa: BLE001
+            import traceback
+
+            errs.append((i, traceback.format_exc()))
 
     threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
     for th_ in threads:
@@ -368,3 +370,34 @@ def test_networked_dkg_survives_false_complaint():
     assert r0.qualified == tuple(range(n))  # dealer 0 survives
     for r in results[1:]:
         assert r.group_pk == r0.group_pk and r.share_pks == r0.share_pks
+
+
+def test_session_tolerates_share_and_reveal_before_commitments():
+    """Separate frames race over a real network: a share (or reveal)
+    arriving before its dealer's commitments must be stashed and
+    re-judged when they land — not misread as dealer fault (round-5
+    flake: a late-starting participant complained about every dealer,
+    then rejected their valid reveals for want of commitments,
+    diverging the qualified set)."""
+    n, t = 3, 2
+    seeds = _seeds(n)
+    pks = [ed.generate_keypair(s)[1] for s in seeds]
+    dealer = dkg.DkgSession(0, n, t, seeds[0], pks)
+    late = dkg.DkgSession(1, n, t, seeds[1], pks)
+    share = dealer.share_blob_for(1)
+    # share first: no verdict, no complaint
+    assert not late.on_share(0, share)
+    assert 0 not in late._my_complaints
+    # commitments land -> stashed share is adopted
+    assert late.on_commitments(0, dealer.commitment_blob())
+    assert late.shares[0] is not None and 0 not in late._my_complaints
+    # reveal-before-commitments on a third session
+    judge = dkg.DkgSession(2, n, t, seeds[2], pks)
+    judge.on_complaint(1, 0)  # participant 1 complains about dealer 0
+    reveal = dealer.reveal_blob(1)
+    judge.on_reveal(0, 1, reveal)   # can't be judged yet
+    assert (0, 1) in judge._open_complaints
+    assert 0 not in judge.disqualified
+    judge.on_commitments(0, dealer.commitment_blob())  # replays the reveal
+    assert (0, 1) not in judge._open_complaints
+    assert 0 not in judge.disqualified
