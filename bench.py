@@ -955,9 +955,12 @@ def _run_stage(stage: str, env: dict, timeout_s: float):
             except json.JSONDecodeError:
                 continue
     tail = "; ".join((err or "").strip().splitlines()[-6:])[-700:]
-    if parsed is None:
+    if parsed is None or rc != 0:
+        # A stage that crashed AFTER emitting a progressive JSON line
+        # still parses — surface the rc so main() can mark the record
+        # truncated instead of silently passing it off as complete.
         tail = f"rc={rc}; {tail}"
-    return parsed, tail
+    return parsed, tail, rc
 
 
 def main() -> None:
@@ -1023,7 +1026,7 @@ def main() -> None:
         )
         _mark(f"outer: probing primary backend, attempt {attempt} (timeout {pt:.0f}s)")
         _relay_log(f"probe attempt {attempt} start (timeout {pt:.0f}s)")
-        probe_i, tail = _run_stage("probe", dict(os.environ), pt)
+        probe_i, tail, _ = _run_stage("probe", dict(os.environ), pt)
         if probe_i and probe_i.get("probe_ok"):
             probe = probe_i
             _mark(f"outer: probe ok ({probe})")
@@ -1038,16 +1041,26 @@ def main() -> None:
             env = dict(os.environ)
             env["DAGRIDER_BENCH_SECONDS"] = str(meas_timeout - 20.0)
             _mark(f"outer: measuring on primary (timeout {meas_timeout:.0f}s)")
-            result, mtail = _run_stage("measure", env, meas_timeout)
+            result, mtail, mrc = _run_stage("measure", env, meas_timeout)
             _relay_log(
                 "primary measure "
-                + ("ok" if result and result.get("value") else f"failed: {mtail[:200]}")
+                + (
+                    f"ok (rc={mrc})"
+                    if result and result.get("value")
+                    else f"failed: {mtail[:200]}"
+                )
             )
             if result is None or not result.get("value"):
                 notes.append(f"primary measure: {mtail}")
                 if result is not None:
                     notes.append("primary measure returned zero value")
                     result = None
+            elif mrc != 0:
+                # crashed mid-measure after a progressive emit: keep the
+                # partial record (it carries real on-chip phases) but say
+                # so — a truncated ladder must not read as a short one
+                result["truncated"] = True
+                notes.append(f"measure stage exited rc={mrc} mid-run: {mtail}")
             break
         notes.append(f"probe attempt {attempt} failed: {tail}")
         _mark(f"outer: probe attempt {attempt} FAILED ({tail})")
@@ -1057,10 +1070,13 @@ def main() -> None:
             # bank a CPU number while waiting for the relay to recover
             cpu_timeout = max(60.0, min(cpu_reserve, budget - elapsed() - 100.0))
             _mark(f"outer: CPU fallback between probes (timeout {cpu_timeout:.0f}s)")
-            cpu_result, ctail = run_cpu_fallback(cpu_timeout)
+            cpu_result, ctail, crc = run_cpu_fallback(cpu_timeout)
             banked = cpu_result is not None
             if not banked:
                 notes.append(f"cpu fallback: {ctail}")
+            elif crc != 0:
+                cpu_result["truncated"] = True
+                notes.append(f"cpu fallback exited rc={crc} mid-run: {ctail}")
         if not banked:
             # Always pace failed probes — a probe (or fallback) that
             # fails in <1s (e.g. ImportError of a base dep) must not
@@ -1075,9 +1091,12 @@ def main() -> None:
         # terminal CPU fallback — a number must always exist
         cpu_timeout = max(60.0, min(cpu_reserve, budget - elapsed()))
         _mark(f"outer: terminal CPU fallback (timeout {cpu_timeout:.0f}s)")
-        cpu_result, ctail = run_cpu_fallback(cpu_timeout)
+        cpu_result, ctail, crc = run_cpu_fallback(cpu_timeout)
         if cpu_result is None:
             notes.append(f"cpu fallback: {ctail}")
+        elif crc != 0:
+            cpu_result["truncated"] = True
+            notes.append(f"cpu fallback exited rc={crc} mid-run: {ctail}")
 
     if result is None:
         result = cpu_result

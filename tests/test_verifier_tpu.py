@@ -149,3 +149,25 @@ def test_commit_order_byte_identical_cpu_vs_tpu():
         sizes = [s for p in sim.processes for s in p.metrics.verify_batch_sizes]
         assert sizes and sum(sizes) / len(sizes) >= 2.0, sizes
     assert logs["cpu"] == logs["tpu"]
+
+
+def test_verify_batch_survives_pipeline_off_shadow(keys, signed_vertices):
+    """bench.py's sim256_sync rung shadows dispatch_batch/resolve_batch
+    with instance-level None to force the simulator's synchronous branch;
+    verify_batch must reach past the shadow to the class methods (round-5
+    regression: the shadow made verify_batch call None and killed the
+    measure stage mid-ladder)."""
+    reg, _ = keys
+    v = TPUVerifier(reg)
+    baseline = v.verify_batch(signed_vertices)
+    v.dispatch_batch = None
+    v.resolve_batch = None
+    try:
+        assert v.verify_batch(signed_vertices) == baseline
+        assert all(baseline)
+    finally:
+        del v.dispatch_batch
+        del v.resolve_batch
+    # the shadow is gone: the async seam is usable again
+    pending = v.dispatch_batch(signed_vertices)
+    assert v.resolve_batch(pending) == baseline
