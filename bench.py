@@ -48,22 +48,35 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 _T0 = time.monotonic()
 
 
+def _append_log(path: str, line: str) -> None:
+    """Wall-clock-stamped append; never lets log IO break a bench stage."""
+    try:
+        import datetime
+
+        stamp = datetime.datetime.now().isoformat(timespec="seconds")
+        with open(path, "a") as fh:
+            fh.write(f"{stamp} {line}\n")
+    except OSError:
+        pass
+
+
 def _mark(msg: str) -> None:
-    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    line = f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}"
+    print(line, file=sys.stderr, flush=True)
+    # Tee into a per-run file (parent truncates it at start, children
+    # append): a stage killed by the parent's timeout loses its piped
+    # stderr, and the postmortem needs the LAST mark — e.g. "importing
+    # jax" vs "backend up" decides wedged-relay vs slow-compile.
+    path = os.environ.get("DAGRIDER_BENCH_MARK_FILE")
+    if path:
+        _append_log(path, f"[pid {os.getpid()}] {line}")
 
 
 def _relay_log(msg: str) -> None:
     """Persist a wall-clock-timestamped relay-health line (round-4 VERDICT
     #1: make a wedged relay distinguishable from a compile timeout after
     the fact — stderr is lost once the driver truncates it)."""
-    try:
-        import datetime
-
-        stamp = datetime.datetime.now().isoformat(timespec="seconds")
-        with open(os.path.join(_REPO, "relay_health.log"), "a") as fh:
-            fh.write(f"{stamp} {msg}\n")
-    except OSError:
-        pass
+    _append_log(os.path.join(_REPO, "relay_health.log"), msg)
 
 
 # ----------------------------------------------------------------------
@@ -206,6 +219,8 @@ def _sim_rung(
     coin: str = "round_robin",
     gc_depth: int = 24,
     pipelined: bool = True,
+    target_per_view: int = 0,
+    max_s: float = 0.0,
 ):
     """Time-boxed consensus-in-the-loop simulation (BASELINE configs #3/#4
     live halves): n processes, shared device verifier (coalesced + async
@@ -265,10 +280,28 @@ def _sim_rung(
         # overlap cuts wave-commit p50 (round-4 VERDICT #4).
         verifier.dispatch_batch = None
         verifier.resolve_batch = None
+    tot0 = (
+        getattr(verifier, "total_prepare_s", 0.0),
+        getattr(verifier, "total_dispatch_s", 0.0),
+        getattr(verifier, "total_dispatches", 0),
+        getattr(verifier, "total_sigs_dispatched", 0),
+    )
     try:
         t0 = _t.monotonic()
         pumped = 0
-        while _t.monotonic() - t0 < box_s:
+        while True:
+            el = _t.monotonic() - t0
+            if el >= box_s:
+                # optional extension past the box until the rung's own
+                # spec is met (BASELINE config #3: >= 10k vertices per
+                # view) — bounded by max_s so it cannot eat the ladder
+                if (
+                    not target_per_view
+                    or el >= max_s
+                    or max((len(d) for d in sim.deliveries), default=0)
+                    >= target_per_view
+                ):
+                    break
             pumped += sim.run(max_messages=chunk)
         dt = _t.monotonic() - t0
     finally:
@@ -306,6 +339,23 @@ def _sim_rung(
         "wave_commit_p50_ms": (
             round(1e3 * waves[len(waves) // 2], 2) if waves else None
         ),
+        # where the wall time went at the verifier seam (VERDICT r04 #2:
+        # a shortfall must be attributable): host prep vs device
+        # dispatch+sync vs everything else (admission, ordering, coin,
+        # message pump)
+        "verifier_breakdown": (lambda p, d, c, s: {
+            "prepare_s": round(p, 2),
+            "device_s": round(d, 2),
+            "host_other_s": round(max(0.0, dt - p - d), 2),
+            "dispatches": c,
+            "sigs_dispatched": s,
+            "ms_per_dispatch": round(1e3 * d / c, 1) if c else None,
+        })(
+            getattr(verifier, "total_prepare_s", 0.0) - tot0[0],
+            getattr(verifier, "total_dispatch_s", 0.0) - tot0[1],
+            getattr(verifier, "total_dispatches", 0) - tot0[2],
+            getattr(verifier, "total_sigs_dispatched", 0) - tot0[3],
+        ),
     }
 
 
@@ -330,10 +380,40 @@ def _measure() -> None:
     import numpy as np
     import jax.numpy as jnp
 
+    # Init watchdog: a relay that wedges BETWEEN the probe and this stage
+    # (observed round 5: probe OK at T, measure init hung 3 s later for
+    # the whole 37 min window) must fail fast so the outer loop can
+    # re-probe or fall back — a successful probe minutes ago proves
+    # nothing about this process's connection. A daemon THREAD (not
+    # SIGALRM: the hang sits inside the blocking PJRT C++ handshake,
+    # where a Python signal handler would not run until the call
+    # returns) hard-exits rc=3 so the parent sees a deliberate abort,
+    # not a mid-ladder death.
+    import threading
+
+    watchdog_s = float(os.environ.get("DAGRIDER_BENCH_INIT_WATCHDOG", "150"))
+    init_done = threading.Event()
+
+    def _init_watchdog():
+        if not init_done.wait(watchdog_s):
+            _mark(
+                f"measure: backend init/first-dispatch exceeded "
+                f"{watchdog_s:.0f}s watchdog — relay wedged; aborting stage"
+            )
+            _relay_log(f"measure stage init watchdog ({watchdog_s:.0f}s) fired")
+            sys.stderr.flush()
+            os._exit(3)
+
+    if watchdog_s > 0:
+        threading.Thread(target=_init_watchdog, daemon=True).start()
     t0 = time.monotonic()
     backend = jax.default_backend()
     device_kind = getattr(jax.devices()[0], "device_kind", "?")
+    # one tiny dispatch: init can "succeed" while the first real
+    # transfer wedges — cover both under the same watchdog
+    jnp.zeros((8,), dtype=jnp.int32).sum().block_until_ready()
     init_s = time.monotonic() - t0
+    init_done.set()
     _mark(f"measure: backend '{backend}' ({device_kind}) up in {init_s:.1f}s")
 
     result = {
@@ -633,7 +713,17 @@ def _measure() -> None:
         shared.verify_batch(warm_all[:9])  # one compile at the fixed bucket
         _mark("ladder sim64: fixed-bucket program pre-warmed")
         entry = _sim_rung(
-            n, sim_budget, shared, signers, bucket=4096, chunk=4032
+            n,
+            sim_budget,
+            shared,
+            signers,
+            bucket=4096,
+            chunk=4032,
+            # BASELINE config #3 says a 10k-vertex DAG; keep pumping past
+            # the box until a view holds 10k vertices (bounded so the
+            # remaining ladder rungs still fit)
+            target_per_view=10_000,
+            max_s=max(sim_budget, min(240.0, left() - 150.0)),
         )
         result["ladder"]["sim64"] = entry
         if result.get("wave_commit_p50_ms") is None and entry[
@@ -976,6 +1066,22 @@ def main() -> None:
     # enough for the n=256 phases the fallback now carries (VERDICT #6)
     cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "180"))
     notes = []
+    # Critical diagnostics (mid-run truncation, probe-vs-record
+    # mismatch) are kept separate and joined FIRST: the chronological
+    # probe-failure notes alone can exceed fallback_reason's 800-char
+    # cap in a multi-attempt run, and the structural facts must not
+    # be the part that falls off.
+    key_notes = []
+
+    # fresh per-run stage-mark tee (see _mark): the postmortem artifact
+    # for any stage the parent has to kill
+    mark_file = os.environ.setdefault(
+        "DAGRIDER_BENCH_MARK_FILE", os.path.join(_REPO, "bench_marks.log")
+    )
+    try:
+        open(mark_file, "w").close()
+    except OSError:
+        pass
 
     def elapsed() -> float:
         return time.monotonic() - _T0
@@ -1055,16 +1161,30 @@ def main() -> None:
                 if result is not None:
                     notes.append("primary measure returned zero value")
                     result = None
-            elif mrc != 0:
-                # crashed mid-measure after a progressive emit: keep the
-                # partial record (it carries real on-chip phases) but say
-                # so — a truncated ladder must not read as a short one
-                result["truncated"] = True
-                notes.append(f"measure stage exited rc={mrc} mid-run: {mtail}")
-            break
-        notes.append(f"probe attempt {attempt} failed: {tail}")
-        _mark(f"outer: probe attempt {attempt} FAILED ({tail})")
-        _relay_log(f"probe attempt {attempt} FAILED: {tail[:300]}")
+                # The relay can wedge BETWEEN a good probe and the measure
+                # stage's own init (round-5 postmortem) — with the init
+                # watchdog the failure costs ~150s, not the window, so
+                # keep cycling probe->measure while the budget allows.
+                # Fall through to the shared banking/pacing block below:
+                # probe-ok/measure-fail cycles must bank a CPU number
+                # too, or they starve the terminal fallback to its 60s
+                # floor.
+                _mark("outer: primary measure failed; will re-probe")
+            else:
+                if mrc != 0:
+                    # crashed mid-measure after a progressive emit: keep
+                    # the partial record (it carries real on-chip phases)
+                    # but say so — a truncated ladder must not read as a
+                    # short one
+                    result["truncated"] = True
+                    key_notes.append(
+                        f"measure stage exited rc={mrc} mid-run: {mtail}"
+                    )
+                break
+        else:
+            notes.append(f"probe attempt {attempt} failed: {tail}")
+            _mark(f"outer: probe attempt {attempt} FAILED ({tail})")
+            _relay_log(f"probe attempt {attempt} FAILED: {tail[:300]}")
         banked = False
         if cpu_result is None and budget - elapsed() > cpu_reserve + 130.0:
             # bank a CPU number while waiting for the relay to recover
@@ -1076,7 +1196,7 @@ def main() -> None:
                 notes.append(f"cpu fallback: {ctail}")
             elif crc != 0:
                 cpu_result["truncated"] = True
-                notes.append(f"cpu fallback exited rc={crc} mid-run: {ctail}")
+                key_notes.append(f"cpu fallback exited rc={crc} mid-run: {ctail}")
         if not banked:
             # Always pace failed probes — a probe (or fallback) that
             # fails in <1s (e.g. ImportError of a base dep) must not
@@ -1096,7 +1216,7 @@ def main() -> None:
             notes.append(f"cpu fallback: {ctail}")
         elif crc != 0:
             cpu_result["truncated"] = True
-            notes.append(f"cpu fallback exited rc={crc} mid-run: {ctail}")
+            key_notes.append(f"cpu fallback exited rc={crc} mid-run: {ctail}")
 
     if result is None:
         result = cpu_result
@@ -1110,14 +1230,28 @@ def main() -> None:
             "backend": "none",
         }
     if probe:
-        result.setdefault("phases", {})["probe"] = probe
-    if notes:
+        if result is not cpu_result and result.get("value"):
+            # only a record actually measured on the probed backend gets
+            # the probe attached — not the CPU fallback, and not the
+            # synthesized zero-value record below
+            result.setdefault("phases", {})["probe"] = probe
+        else:
+            # a TPU probe succeeded at some point but every measure on it
+            # failed — a postmortem reading phases.probe on a CPU (or
+            # empty) record would conclude the chip was reachable for
+            # THIS measurement
+            key_notes.append(
+                f"a primary probe succeeded ({probe.get('backend')}) but "
+                "no primary measurement completed; record is a fallback"
+            )
+    if notes or key_notes:
         # Head-preserving truncation: each note keeps its lead (the
         # attempt tag + rc), the join keeps the FIRST 800 chars — the
         # round-4 record's tail-clip produced garbled reasons like
-        # "e attempt 2 failed: rc=timeout; ...".
+        # "e attempt 2 failed: rc=timeout; ...". Critical diagnostics
+        # join first so the chronological probe spam is what falls off.
         result["fallback_reason"] = " || ".join(
-            n[:240] for n in notes
+            [n[:240] for n in key_notes] + [n[:240] for n in notes]
         )[:800]
     print(json.dumps(result))
 

@@ -137,6 +137,16 @@ class Simulation:
                             verify_s = (time.perf_counter() - t0) - (
                                 tf1 - tf0
                             )
+                            # device share of the pipelined dispatch for
+                            # the verifier's cumulative breakdown (the
+                            # sync path accounts itself in verify_batch;
+                            # dispatch() above already booked its prep)
+                            if hasattr(shared, "total_dispatch_s"):
+                                shared.total_dispatch_s += max(
+                                    0.0,
+                                    verify_s
+                                    - getattr(shared, "last_prepare_s", 0.0),
+                                )
                         else:
                             if pipelined:
                                 # no overlap window in the chunked path —

@@ -440,6 +440,17 @@ class TPUVerifier(Verifier):
     last_prepare_s: float = 0.0
     last_dispatch_s: float = 0.0
 
+    #: Cumulative verifier-seam accounting across a whole run: how much
+    #: wall time went to host prep vs device dispatch+sync, over how
+    #: many dispatches and signatures. The bench's sim rungs report
+    #: these so an in-loop sigs/s shortfall is ATTRIBUTABLE (fixed
+    #: per-dispatch relay cost vs host consensus work) instead of a
+    #: bare number — VERDICT r04 #2's "measured breakdown".
+    total_prepare_s: float = 0.0
+    total_dispatch_s: float = 0.0
+    total_dispatches: int = 0
+    total_sigs_dispatched: int = 0
+
     #: When set, every dispatch pads to exactly this bucket (and
     #: verify_rounds chunks larger merges into it) — ONE compiled program
     #: shape for a whole consensus run, instead of a power-of-two ladder
@@ -460,6 +471,9 @@ class TPUVerifier(Verifier):
         with jax.profiler.TraceAnnotation("verify_batch.prepare"):
             args = self._prepare(vertices, size, comb=self._comb)
         self.last_prepare_s = time.perf_counter() - t0
+        self.total_prepare_s += self.last_prepare_s
+        self.total_dispatches += 1
+        self.total_sigs_dispatched += len(vertices)
         with jax.profiler.TraceAnnotation("verify_batch.dispatch"):
             if self._comb:
                 u8, i32 = args
@@ -541,4 +555,5 @@ class TPUVerifier(Verifier):
         t0 = time.perf_counter()
         out = resolve(pending)
         self.last_dispatch_s = time.perf_counter() - t0
+        self.total_dispatch_s += self.last_dispatch_s
         return out
