@@ -396,27 +396,33 @@ class Process:
             exists = self.dag.exists  # re-fetch: capacity growth reallocates
             base = self.dag.base_round
             keep: List[Vertex] = []
+            # Pass 1: cheap filters; survivors become candidates for ONE
+            # vectorized predecessor check over the whole buffer.
+            cand: List[Vertex] = []
+            cand_arrs = []
             for v in self.buffer:
-                if v.id.round > self.round:
+                vid = v.id
+                if vid.round > self.round:
                     keep.append(v)
                     continue
-                if v.id.round <= base:
+                if vid.round <= base:
                     # Below the pruned floor: its predecessors are retired
                     # and the GC ordering rule excludes it from delivery
-                    # anywhere — unadmittable, drop it.
-                    self._buffered_ids.discard(v.id)
-                    blocked.pop(v.id, None)
+                    # anywhere — unadmittable, drop it. (No re-pass: a
+                    # drop adds nothing to the DAG, so it cannot unlock
+                    # any other vertex's predecessor check.)
+                    self._buffered_ids.discard(vid)
+                    blocked.pop(vid, None)
                     self.metrics.inc("msgs_below_gc_horizon")
-                    changed = True
                     continue
-                if present(v.id):
-                    # raced in via another path; drop rather than re-insert
-                    self._buffered_ids.discard(v.id)
-                    blocked.pop(v.id, None)
+                if present(vid):
+                    # raced in via another path; drop rather than
+                    # re-insert (no re-pass — see above)
+                    self._buffered_ids.discard(vid)
+                    blocked.pop(vid, None)
                     self.metrics.inc("msgs_duplicate")
-                    changed = True
                     continue
-                bp = blocked.get(v.id)
+                bp = blocked.get(vid)
                 if (
                     bp is not None
                     and bp.round > base
@@ -429,32 +435,73 @@ class Process:
                 # base satisfaction rule below must get its chance, or a
                 # vertex blocked before a prune would wait forever on a
                 # round nobody can serve anymore)
-                # Vectorized predecessor check against the dense mirror
-                # (edge rounds/sources are gate-validated in [0, n) and
-                # below v.round <= self.round < capacity, so the fancy
-                # index cannot alias): two indexed reads replace ~2f+1
-                # dict probes — the hottest slice of the 64-node profile.
-                sr, ss, wr, ws = v.edge_arrays()
-                s_hit = exists[v.id.round - 1 - base, ss]
-                preds_present = bool(s_hit.all())
-                if not preds_present:
-                    k = int(np.argmin(s_hit))
-                    blocked[v.id] = VertexID(v.id.round - 1, int(ss[k]))
-                elif wr.size:
-                    if base:
-                        # weak targets under the pruned floor are in
-                        # finalized history — treated satisfied (they can
-                        # never be re-fetched, and ordering never descends
-                        # below the GC horizon).
-                        w_live = wr > base
-                        wr, ws = wr[w_live], ws[w_live]
+                cand.append(v)
+                cand_arrs.append(v.edge_arrays())
+            # Pass 2: strong-predecessor check for ALL candidates in one
+            # fancy index + one segmented reduce against the dense mirror
+            # (edge rounds/sources are gate-validated in [0, n) and below
+            # v.round <= self.round < capacity, so the index cannot
+            # alias). The per-candidate numpy-call version of this check
+            # was ~half the n=256 host profile. Admissions land in pass 3
+            # AFTER this snapshot; a candidate whose predecessor is
+            # admitted later in the same sweep just waits for the next
+            # while-pass — same fixpoint, identical admitted set.
+            if cand:
+                lens = np.fromiter(
+                    (a[1].size for a in cand_arrs),
+                    dtype=np.intp,
+                    count=len(cand),
+                )
+                rows = (
+                    np.fromiter(
+                        (v.id.round for v in cand),
+                        dtype=np.intp,
+                        count=len(cand),
+                    )
+                    - 1
+                    - base
+                )
+                ss_cat = (
+                    np.concatenate([a[1] for a in cand_arrs])
+                    if len(cand) > 1
+                    else cand_arrs[0][1]
+                )
+                hits = exists[np.repeat(rows, lens), ss_cat]
+                offs = np.zeros(len(cand), dtype=np.intp)
+                np.cumsum(lens[:-1], out=offs[1:])
+                # every vertex carries >= quorum >= 1 strong edges (the
+                # admission gate proved it), so no zero-length segment
+                ok = np.bitwise_and.reduceat(hits, offs)
+                # Pass 3: admit / memo the first missing blocker.
+                for i, v in enumerate(cand):
+                    if not ok[i]:
+                        seg = hits[offs[i] : offs[i] + lens[i]]
+                        k = int(np.argmin(seg))
+                        blocked[v.id] = VertexID(
+                            v.id.round - 1, int(cand_arrs[i][1][k])
+                        )
+                        keep.append(v)
+                        continue
+                    _, _, wr, ws = cand_arrs[i]
                     if wr.size:
-                        w_hit = exists[wr - base, ws]
-                        preds_present = bool(w_hit.all())
-                        if not preds_present:
-                            k = int(np.argmin(w_hit))
-                            blocked[v.id] = VertexID(int(wr[k]), int(ws[k]))
-                if preds_present:
+                        if base:
+                            # weak targets under the pruned floor are in
+                            # finalized history — treated satisfied (they
+                            # can never be re-fetched, and ordering never
+                            # descends below the GC horizon).
+                            w_live = wr > base
+                            wr, ws = wr[w_live], ws[w_live]
+                        if wr.size:
+                            # live mirror, not the pass-2 snapshot: an
+                            # insert below may have grown capacity
+                            w_hit = self.dag.exists[wr - base, ws]
+                            if not w_hit.all():
+                                k = int(np.argmin(w_hit))
+                                blocked[v.id] = VertexID(
+                                    int(wr[k]), int(ws[k])
+                                )
+                                keep.append(v)
+                                continue
                     blocked.pop(v.id, None)
                     self.dag.insert(v)
                     self._buffered_ids.discard(v.id)
@@ -464,8 +511,6 @@ class Process:
                     )
                     changed = True
                     admitted_any = True
-                else:
-                    keep.append(v)
             self.buffer = keep
         return admitted_any
 
@@ -508,9 +553,11 @@ class Process:
             if self.blocks_to_propose
             else Block()
         )
+        # u.id IS VertexID(rnd-1, u.source) — reuse instead of
+        # re-constructing n ids per proposal (a top allocation site of
+        # the n=256 host profile)
         strong = tuple(
-            VertexID(rnd - 1, u.source)
-            for u in self.dag.vertices_in_round(rnd - 1)
+            u.id for u in self.dag.vertices_in_round(rnd - 1)
         )
         weak = self._weak_edges_for(rnd, strong)
         share = None

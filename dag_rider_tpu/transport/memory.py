@@ -88,10 +88,43 @@ class InMemoryTransport(Transport):
         return True
 
     def pump(self, max_messages: int | None = None) -> int:
-        """Deliver until the queue drains (or ``max_messages``)."""
+        """Deliver until the queue drains (or ``max_messages``).
+
+        Messages are popped in batches under ONE lock acquisition and
+        delivered outside it — per-message lock round-trips were ~10% of
+        the n=256 host profile. Handlers may broadcast re-entrantly
+        (their sends append under the lock and are picked up by the next
+        batch pop), and delivery order is unchanged: batches pop from
+        the head in FIFO order.
+        """
         delivered = 0
-        while (max_messages is None or delivered < max_messages) and self.pump_one():
-            delivered += 1
+        handlers = self._handlers
+        while max_messages is None or delivered < max_messages:
+            want = 1024 if max_messages is None else min(
+                1024, max_messages - delivered
+            )
+            with self._lock:
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(want, len(self._queue)))
+                ]
+            if not batch:
+                break
+            done = 0
+            try:
+                for dest, msg in batch:
+                    handlers[dest](msg)
+                    done += 1
+            finally:
+                # A handler that raises mid-batch must lose at most the
+                # ONE in-flight message (pump_one semantics): requeue the
+                # undelivered tail at the head, count the delivered
+                # prefix.
+                if done < len(batch):
+                    with self._lock:
+                        self._queue.extendleft(reversed(batch[done + 1 :]))
+                self.delivered_count += done
+                delivered += done
         return delivered
 
     @property

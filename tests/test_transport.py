@@ -89,3 +89,37 @@ def test_concurrent_broadcast_and_subscribe_race_free():
         t.join()
     assert not errors
     tp.pump()
+
+
+def test_pump_batch_requeues_tail_when_handler_raises():
+    """Batched pump must keep pump_one's failure semantics: a handler
+    exception mid-batch loses only the in-flight message — the popped
+    but undelivered tail goes back to the queue head in order."""
+    tp = InMemoryTransport()
+    got = []
+    boom = {"armed": True}
+
+    def good(msg):
+        got.append(msg.round)
+
+    def bad(msg):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("handler bug")
+        got.append(-msg.round)
+
+    tp.subscribe(0, good)
+    tp.subscribe(1, bad)
+    for r in range(1, 4):
+        tp.broadcast(BroadcastMessage(kind="val", vertex=None, round=r, sender=2))
+    # queue: (0,r1) (1,r1) (0,r2) (1,r2) (0,r3) (1,r3)
+    try:
+        tp.pump()
+    except RuntimeError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("handler exception must propagate")
+    # (0,r1) delivered, (1,r1) lost in flight, tail requeued in order
+    assert got == [1]
+    assert tp.pump() == 4
+    assert got == [1, 2, -2, 3, -3]
