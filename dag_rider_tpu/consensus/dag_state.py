@@ -49,6 +49,11 @@ class DagState:
         self.base_round = 0
         self.exists = np.zeros((self._capacity, self.n), dtype=bool)
         self.strong = np.zeros((self._capacity, self.n, self.n), dtype=bool)
+        #: dense mirror of ``weak``'s key set: has_weak[row, src] is True
+        #: iff weak[(base+row, src)] exists. Weak edges are rare, and the
+        #: closure sweeps were paying a dict probe per ACTIVE source per
+        #: round (~1M probes per n=256 bench window) to discover that.
+        self.has_weak = np.zeros((self._capacity, self.n), dtype=bool)
         # weak[(r, i)] -> tuple of (r2, j) targets, r2 < r-1 (absolute).
         self.weak: Dict[Tuple[int, int], Tuple[Tuple[int, int], ...]] = {}
         self.vertices: Dict[VertexID, Vertex] = {}
@@ -71,6 +76,7 @@ class DagState:
         self._round_vertices.clear()
         self.exists[:] = False
         self.strong[:] = False
+        self.has_weak[:] = False
         self.weak.clear()
         self.base_round = 0
         self.max_round = 0
@@ -88,9 +94,11 @@ class DagState:
             new_cap *= 2
         exists = np.zeros((new_cap, self.n), dtype=bool)
         strong = np.zeros((new_cap, self.n, self.n), dtype=bool)
+        has_weak = np.zeros((new_cap, self.n), dtype=bool)
         exists[: self._capacity] = self.exists
         strong[: self._capacity] = self.strong
-        self.exists, self.strong = exists, strong
+        has_weak[: self._capacity] = self.has_weak
+        self.exists, self.strong, self.has_weak = exists, strong, has_weak
         self._capacity = new_cap
 
     def prune_below(self, floor: int) -> int:
@@ -112,8 +120,10 @@ class DagState:
             # .copy(): numpy overlapping slice assignment is not defined
             self.exists[:live] = self.exists[shift:].copy()
             self.strong[:live] = self.strong[shift:].copy()
+            self.has_weak[:live] = self.has_weak[shift:].copy()
         self.exists[max(live, 0) :] = False
         self.strong[max(live, 0) :] = False
+        self.has_weak[max(live, 0) :] = False
         removed = 0
         for r in [r for r in self._round_vertices if r < floor]:
             for v in self._round_vertices.pop(r).values():
@@ -164,6 +174,7 @@ class DagState:
         self.strong[row, s, ss] = True
         if wr.size:
             self.weak[(r, s)] = tuple(zip(wr.tolist(), ws.tolist()))
+            self.has_weak[row, s] = True
         if r > self.max_round:
             self.max_round = r
         if r < self.insert_min_round:
@@ -225,7 +236,9 @@ class DagState:
             # strong: one vector-matrix product per round.
             reached[r - base - 1] |= row @ self.strong[r - base]
             if not strong_only:
-                for i in np.flatnonzero(row):
+                # has_weak prefilter: only sources that actually carry
+                # weak edges get the dict probe (weak edges are rare)
+                for i in np.flatnonzero(row & self.has_weak[r - base]):
                     for (r2, j) in self.weak.get((r, i), ()):
                         if r2 >= base:
                             reached[r2 - base, j] = True
@@ -257,7 +270,8 @@ class DagState:
             act = reached[row] & ~stop_mask[row]
             if act.any():
                 reached[row - 1] |= act @ self.strong[row]
-                for i in np.flatnonzero(act):
+                # has_weak prefilter — see closure()
+                for i in np.flatnonzero(act & self.has_weak[row]):
                     for (r2, j) in self.weak.get((r, i), ()):
                         if r2 >= base:
                             reached[r2 - base, j] = True
