@@ -29,8 +29,10 @@ env DAGRIDER_BENCH_STAGE=measure \
     DAGRIDER_BENCH_SIM_S=60 \
     DAGRIDER_BENCH_SIM256_S=90 \
     DAGRIDER_BENCH_SIM256_SYNC_S=40 \
+    DAGRIDER_BENCH_SIM256_BUCKET="${SIM256_BUCKET:-65280}" \
     DAGRIDER_BENCH_HOSTSIM_S=12 \
     DAGRIDER_BENCH_HOSTSIM256_S=12 \
+    DAGRIDER_BENCH_MARK_FILE="$PWD/bench_marks.log" \
     timeout $((BUDGET + 120)) python -u bench.py > "$OUT" 2> "$LOG"
 rc=$?
 tail -5 "$LOG" >&2
