@@ -64,6 +64,10 @@ _SLOW = {
     "test_determinism.py::test_device_verify_is_deterministic",
     "test_determinism.py::test_cpu_vs_device_verifier_commit_order_byte_identical",
     "test_coin_e2e.py::test_byzantine_share_cannot_stall_the_coin",
+    # bench-rung mechanics: real consensus runs w/ device verifier
+    "test_bench_rungs.py::test_sim_rung_reports_breakdown_and_progress",
+    "test_bench_rungs.py::test_sim_rung_extends_past_box_until_target_met",
+    "test_bench_rungs.py::test_sim_rung_pipeline_off_runs_and_restores_seam",
 }
 
 
