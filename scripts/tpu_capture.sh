@@ -29,7 +29,7 @@ env DAGRIDER_BENCH_STAGE=measure \
     DAGRIDER_BENCH_SIM_S=60 \
     DAGRIDER_BENCH_SIM256_S=90 \
     DAGRIDER_BENCH_SIM256_SYNC_S=40 \
-    DAGRIDER_BENCH_SIM256_BUCKET="${SIM256_BUCKET:-65280}" \
+    DAGRIDER_BENCH_SIM256_BUCKET="${SIM256_BUCKET:-512}" \
     DAGRIDER_BENCH_HOSTSIM_S=12 \
     DAGRIDER_BENCH_HOSTSIM256_S=12 \
     DAGRIDER_BENCH_MARK_FILE="$PWD/bench_marks.log" \

@@ -63,6 +63,8 @@ _SLOW = {
     "test_determinism.py::test_pipelined_coalesced_path_matches_sync_path",
     "test_determinism.py::test_device_verify_is_deterministic",
     "test_determinism.py::test_cpu_vs_device_verifier_commit_order_byte_identical",
+    "test_determinism.py::test_dedup_coalesced_dispatch_is_delivery_identical",
+    "test_determinism.py::test_dedup_does_not_conflate_corrupted_copies",
     "test_coin_e2e.py::test_byzantine_share_cannot_stall_the_coin",
     # bench-rung mechanics: real consensus runs w/ device verifier
     "test_bench_rungs.py::test_sim_rung_reports_breakdown_and_progress",

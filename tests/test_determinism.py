@@ -148,3 +148,79 @@ def test_pipelined_coalesced_path_matches_sync_path():
     assert len(logs[0]) > 50
     k = min(len(logs[0]), len(logs[1]))
     assert logs[0][:k] == logs[1][:k]
+
+
+def test_dedup_coalesced_dispatch_is_delivery_identical():
+    """Round-5 dedup: the shared-verifier coalescing dispatches each
+    unique (digest, signature, source) once and fans the mask out to
+    every sibling copy. Deliveries must be byte-identical with dedup on
+    and off, and the device must see strictly fewer signatures."""
+    from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+    from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+    n = 8
+    reg, seeds = KeyRegistry.generate(n)
+    signers = [VertexSigner(s) for s in seeds]
+    logs, dispatched, applied = [], [], []
+    for dedup in (True, False):
+        cfg = Config(n=n, coin="round_robin", propose_empty=True)
+        shared = TPUVerifier(reg)
+        shared.fixed_bucket = 128
+        sim = Simulation(
+            cfg,
+            verifier_factory=lambda i: shared,
+            signer_factory=lambda i: signers[i],
+        )
+        sim.dedup = dedup
+        sim.submit_blocks(per_process=2)
+        for _ in range(12):
+            sim.run(max_messages=n * (n - 1))
+        sim.check_agreement()
+        logs.append(
+            [
+                (v.id.round, v.id.source, v.digest())
+                for v in sim.deliveries[0]
+            ]
+        )
+        dispatched.append(shared.total_sigs_dispatched)
+        applied.append(
+            sum(p.metrics.verify_sigs_total for p in sim.processes)
+        )
+    assert len(logs[0]) > 20
+    k = min(len(l) for l in logs)
+    assert logs[0][:k] == logs[1][:k]
+    # applied counts match (per-process semantics unchanged)...
+    assert applied[0] == applied[1]
+    # ...while the device dispatched ~1/(n-1) of the copies
+    assert dispatched[0] * 2 < dispatched[1], (dispatched, applied)
+
+
+def test_dedup_does_not_conflate_corrupted_copies():
+    """A copy whose signature (or content) differs must keep its own
+    mask bit: corrupting ONE process's copy of a vertex must reject only
+    that copy."""
+    import dataclasses
+
+    from dag_rider_tpu.core.types import Block, Vertex, VertexID
+    from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+    from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+    reg, seeds = KeyRegistry.generate(4)
+    signers = [VertexSigner(s) for s in seeds]
+    v = Vertex(
+        id=VertexID(3, 1),
+        block=Block((b"tx",)),
+        strong_edges=(VertexID(2, 0), VertexID(2, 1), VertexID(2, 2)),
+    )
+    v = signers[1].sign_vertex(v)
+    bad = dataclasses.replace(v, signature=bytes(64))
+    # the coalesced flat batch: three good copies + one corrupt, through
+    # the PRODUCTION dedup (a private re-implementation here would keep
+    # passing if the simulator's key ever drifted)
+    flat = [v, v, bad, v]
+    shared = TPUVerifier(reg)
+    uniq, inv = Simulation._dedup(flat)
+    assert len(uniq) == 2  # good + corrupt stay separate entries
+    umask = shared.verify_batch(uniq)
+    mask = [umask[j] for j in inv]
+    assert mask == [True, True, False, True]
