@@ -314,6 +314,12 @@ def _sim_rung(
     ]
     waves.sort()
     delivered = sum(len(d) for d in sim.deliveries)
+    # one delta per counter — sigs_device and the breakdown's
+    # sigs_dispatched MUST stay the same number
+    d_prep = getattr(verifier, "total_prepare_s", 0.0) - tot0[0]
+    d_disp = getattr(verifier, "total_dispatch_s", 0.0) - tot0[1]
+    d_count = getattr(verifier, "total_dispatches", 0) - tot0[2]
+    d_sigs = getattr(verifier, "total_sigs_dispatched", 0) - tot0[3]
     return {
         "nodes": n,
         "coin": entry_coin,
@@ -329,13 +335,8 @@ def _sim_rung(
         "messages": pumped,
         "sigs_applied": sigs,
         "sigs_applied_per_sec": round(sigs / dt, 1),
-        "sigs_device": (
-            getattr(verifier, "total_sigs_dispatched", 0) - tot0[3]
-        ),
-        "sigs_device_per_sec": round(
-            (getattr(verifier, "total_sigs_dispatched", 0) - tot0[3]) / dt,
-            1,
-        ),
+        "sigs_device": d_sigs,
+        "sigs_device_per_sec": round(d_sigs / dt, 1),
         "vertices_delivered_total": delivered,
         # per-view DAG size (BASELINE config #3's "10k-vertex DAG" is
         # per view, not summed across the n copies)
@@ -357,19 +358,16 @@ def _sim_rung(
         # a shortfall must be attributable): host prep vs device
         # dispatch+sync vs everything else (admission, ordering, coin,
         # message pump)
-        "verifier_breakdown": (lambda p, d, c, s: {
-            "prepare_s": round(p, 2),
-            "device_s": round(d, 2),
-            "host_other_s": round(max(0.0, dt - p - d), 2),
-            "dispatches": c,
-            "sigs_dispatched": s,
-            "ms_per_dispatch": round(1e3 * d / c, 1) if c else None,
-        })(
-            getattr(verifier, "total_prepare_s", 0.0) - tot0[0],
-            getattr(verifier, "total_dispatch_s", 0.0) - tot0[1],
-            getattr(verifier, "total_dispatches", 0) - tot0[2],
-            getattr(verifier, "total_sigs_dispatched", 0) - tot0[3],
-        ),
+        "verifier_breakdown": {
+            "prepare_s": round(d_prep, 2),
+            "device_s": round(d_disp, 2),
+            "host_other_s": round(max(0.0, dt - d_prep - d_disp), 2),
+            "dispatches": d_count,
+            "sigs_dispatched": d_sigs,
+            "ms_per_dispatch": (
+                round(1e3 * d_disp / d_count, 1) if d_count else None
+            ),
+        },
     }
 
 
@@ -660,6 +658,13 @@ def _measure() -> None:
         sim256_bucket = int(
             os.environ.get("DAGRIDER_BENCH_SIM256_BUCKET", "16384")
         )
+        if sim256_bucket != 16384:
+            # a non-default bucket is a NEW program shape — compile it
+            # OUTSIDE the timed box (the 16384 default reuses the merged
+            # headline phase's program; sim64 pre-warms the same way)
+            _mark(f"ladder sim256: pre-warming bucket-{sim256_bucket} program")
+            verifier.fixed_bucket = sim256_bucket
+            verifier.verify_batch(built[256][1][0][:9])
         entry = _sim_rung(
             256,
             sim256_budget,
@@ -732,16 +737,21 @@ def _measure() -> None:
         # per DAG round — round-3 ran 500-message chunks, paying the
         # fixed dispatch cost 8x per round.
         signers = [VertexSigner(s) for s in seeds]
-        shared.fixed_bucket = 4096
+        # With dispatch dedup a round's unique burst is only n sigs, so
+        # the CPU fallback runs this rung at bucket 128 (dispatch cost
+        # ~180 ms vs the 4096 program's bucket-padded cost) — an in-loop
+        # consensus number with real crypto even on a dead-relay round.
+        sim_bucket = int(os.environ.get("DAGRIDER_BENCH_SIM_BUCKET", "4096"))
+        shared.fixed_bucket = sim_bucket
         warm_all = _signed_round(signers, n, 1, _quorum(n))
         shared.verify_batch(warm_all[:9])  # one compile at the fixed bucket
-        _mark("ladder sim64: fixed-bucket program pre-warmed")
+        _mark(f"ladder sim64: fixed-bucket({sim_bucket}) program pre-warmed")
         entry = _sim_rung(
             n,
             sim_budget,
             shared,
             signers,
-            bucket=4096,
+            bucket=sim_bucket,
             chunk=4032,
             # BASELINE config #3 says a 10k-vertex DAG; keep pumping past
             # the box until a view holds 10k vertices (bounded so the
@@ -1087,8 +1097,9 @@ def main() -> None:
         return
 
     budget = float(os.environ.get("DAGRIDER_BENCH_BUDGET", "540"))
-    # enough for the n=256 phases the fallback now carries (VERDICT #6)
-    cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "180"))
+    # enough for the n=256 phases (VERDICT r4 #6) + the dedup'd in-loop
+    # sim64 rung the fallback now carries
+    cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "210"))
     notes = []
     # Critical diagnostics (mid-run truncation, probe-vs-record
     # mismatch) are kept separate and joined FIRST: the chronological
@@ -1124,10 +1135,13 @@ def main() -> None:
         # an n=256 host consensus rung.
         env["DAGRIDER_BENCH_N256_MIN"] = "90"
         env["DAGRIDER_BENCH_N256_ROUNDS"] = "6"
-        # One 64-node consensus chunk costs ~a minute of CPU verify
-        # dispatches, and the T=1024 MSM runs ~70s/warm-run on CPU —
-        # both rungs are TPU-only.
-        env["DAGRIDER_BENCH_SIM_S"] = "0"
+        # With dispatch dedup (round 5) a 64-node in-loop rung is CPU-
+        # feasible: 63 unique sigs/round through a 128-bucket program
+        # (~180 ms/dispatch warm, compile persisted in .jax_cache) —
+        # ~14k applied sigs/s of real-crypto consensus evidence on a
+        # dead-relay round. The n=256 sim and T=1024 MSM stay TPU-only.
+        env["DAGRIDER_BENCH_SIM_S"] = "20"
+        env["DAGRIDER_BENCH_SIM_BUCKET"] = "128"
         env["DAGRIDER_BENCH_SIM256_S"] = "0"
         env["DAGRIDER_BENCH_HOSTSIM_S"] = "12"  # host consensus evidence
         env["DAGRIDER_BENCH_HOSTSIM256_S"] = "15"
