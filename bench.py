@@ -313,6 +313,14 @@ def _sim_rung(
         s for p in sim.processes for s in p.metrics.wave_commit_seconds
     ]
     waves.sort()
+    # the end-to-end cadence (wall time between consecutive decided
+    # waves, ~4 rounds of verify+consensus each) — the quantity the
+    # round-3 staged proxy modeled; wave_commit_p50_ms below is only
+    # the decide+ordering walk
+    intervals = [
+        s for p in sim.processes for s in p.metrics.wave_interval_seconds
+    ]
+    intervals.sort()
     delivered = sum(len(d) for d in sim.deliveries)
     # one delta per counter — sigs_device and the breakdown's
     # sigs_dispatched MUST stay the same number
@@ -353,6 +361,11 @@ def _sim_rung(
         ),
         "wave_commit_p50_ms": (
             round(1e3 * waves[len(waves) // 2], 2) if waves else None
+        ),
+        "wave_interval_p50_ms": (
+            round(1e3 * intervals[len(intervals) // 2], 2)
+            if intervals
+            else None
         ),
         # where the wall time went at the verifier seam (VERDICT r04 #2:
         # a shortfall must be attributable): host prep vs device

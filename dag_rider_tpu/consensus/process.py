@@ -916,6 +916,9 @@ class Process:
                 cur = prior
         self.decided_wave = wave
         self.metrics.inc("waves_decided")
+        # interval stamp at DECIDE time — a deferred flush that runs two
+        # waves' ordering walks back-to-back must not record ~0 cadence
+        self.metrics.observe_wave_decided()
         self.log.event(
             "wave_decided",
             wave=wave,

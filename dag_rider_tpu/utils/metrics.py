@@ -31,6 +31,10 @@ class Metrics:
         self.verify_batch_seconds: Deque[float] = deque(maxlen=SAMPLE_WINDOW)
         self.verify_batch_sizes: Deque[int] = deque(maxlen=SAMPLE_WINDOW)
         self.wave_commit_seconds: Deque[float] = deque(maxlen=SAMPLE_WINDOW)
+        self.wave_interval_seconds: Deque[float] = deque(
+            maxlen=SAMPLE_WINDOW
+        )
+        self._last_wave_commit_at: float | None = None
         #: exact running totals (never windowed) — the sums consumers use
         self.verify_sigs_total = 0
         self.verify_seconds_total = 0.0
@@ -46,8 +50,25 @@ class Metrics:
 
     def observe_wave_commit(self, seconds: float) -> None:
         """Duration of one decided wave's commit + total-order pass (the
-        BASELINE.json 'p50 wave-commit latency' sample source)."""
+        decide-walk HALF of the BASELINE.json 'p50 wave-commit latency'
+        story — see :meth:`observe_wave_decided` for the end-to-end
+        cadence)."""
         self.wave_commit_seconds.append(seconds)
+
+    def observe_wave_decided(self) -> None:
+        """Stamp a wave DECISION: wall time between consecutive decided
+        waves is the END-TO-END cadence, including the ~4 rounds of
+        verify + consensus a wave costs — the quantity round-3's staged
+        proxy (4 dispatches + commit kernels) modeled. Called at decide
+        time, NOT at the (possibly deferred and batched) ordering flush:
+        two waves flushed together must not record a ~0 interval. The
+        decide-walk sample (observe_wave_commit) deliberately excludes
+        verify — it is amortized across the round pipeline — and
+        reporting both keeps the two from being conflated."""
+        now = time.monotonic()
+        if self._last_wave_commit_at is not None:
+            self.wave_interval_seconds.append(now - self._last_wave_commit_at)
+        self._last_wave_commit_at = now
 
     @staticmethod
     def _p50(samples) -> float:
@@ -70,6 +91,10 @@ class Metrics:
             )
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)
+        if self.wave_interval_seconds:
+            out["wave_interval_p50_ms"] = 1e3 * self._p50(
+                self.wave_interval_seconds
+            )
         return out
 
 
