@@ -247,7 +247,7 @@ class Simulation:
     def delivered_ids(self, i: int) -> List:
         return [v.id for v in self.deliveries[i]]
 
-    def check_agreement(self) -> None:
+    def check_agreement(self, exclude: tuple = ()) -> None:
         """Total order safety: every pair of processes delivered consistent
         prefixes (one may lag the other). All pairs are compared — a lagging
         p0 must not mask divergence between other processes.
@@ -255,13 +255,27 @@ class Simulation:
         Compares delivered *digests*, not just vertex ids: two processes
         that delivered the same (round, source) slots but with different
         payloads (an admitted equivocation) must fail this check (round-1
-        VERDICT missing #6)."""
-        logs = [
-            [(v.id.round, v.id.source, v.digest()) for v in self.deliveries[i]]
-            for i in range(self.cfg.n)
-        ]
-        for i in range(self.cfg.n):
-            for j in range(i + 1, self.cfg.n):
+        VERDICT missing #6).
+
+        ``exclude`` drops Byzantine indices from the comparison: the BFT
+        agreement property covers HONEST processes only — an unsigned
+        equivocator's own log legitimately diverges from the honest
+        quorum's RBC-agreed version of its vertex (with signatures the
+        mutated copies fail verification at honest nodes instead, and
+        the full check passes — see test_full_stack). Default compares
+        everyone, which is the right check whenever no process is
+        deliberately faulty."""
+        excluded = set(exclude)
+        idxs = [i for i in range(self.cfg.n) if i not in excluded]
+        logs = {
+            i: [
+                (v.id.round, v.id.source, v.digest())
+                for v in self.deliveries[i]
+            ]
+            for i in idxs
+        }
+        for ai, i in enumerate(idxs):
+            for j in idxs[ai + 1 :]:
                 a, b = logs[i], logs[j]
                 k = min(len(a), len(b))
                 if a[:k] != b[:k]:

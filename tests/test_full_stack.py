@@ -241,3 +241,24 @@ def test_seven_nodes_two_equivocators_with_rbc(coin_keys):
         for v in d:
             slot_digests.setdefault((v.round, v.source), set()).add(v.digest())
     assert all(len(s) == 1 for s in slot_digests.values())
+
+
+def test_unsigned_equivocator_own_log_outside_agreement():
+    """Without signatures, an equivocating sender's OWN log keeps its
+    original vertex while honest nodes RBC-agree on one (possibly
+    mutated) version — the BFT agreement property covers honest
+    processes only. check_agreement(exclude=) encodes that: the full
+    check must flag the Byzantine node's divergence, the honest-subset
+    check must pass. (Deterministic repro from the round-5 randomized
+    RBC sweep, seed 533502199; no delay in the plan, so a single pump
+    drive suffices.)"""
+    plan = FaultPlan(seed=533502199, equivocators=(3,))
+    faulty = FaultyTransport(plan)
+    cfg = Config(n=4, propose_empty=True, gc_depth=16)
+    sim = Simulation(cfg, transport=faulty, rbc=True)
+    sim.submit_blocks(3)
+    sim.run(max_messages=60_000)
+    assert faulty.stats["equivocated"] > 0
+    sim.check_agreement(exclude=(3,))  # honest subset agrees
+    with pytest.raises(AssertionError, match="divergence"):
+        sim.check_agreement()  # the equivocator's own log diverges
