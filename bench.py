@@ -828,6 +828,18 @@ def _measure() -> None:
             ),
             "agreement": True,
         }
+        host_ivals = sorted(
+            s
+            for p in sim.processes
+            for s in p.metrics.wave_interval_seconds
+        )
+        # always present (null when no 2nd wave decided) — same schema
+        # as the _sim_rung entries
+        result["ladder"][tag]["wave_interval_p50_ms"] = (
+            round(1e3 * host_ivals[len(host_ivals) // 2], 2)
+            if host_ivals
+            else None
+        )
         _mark(
             f"ladder {tag}: {pumped / dt:,.0f} msg/s, round "
             f"{result['ladder'][tag]['max_round']}, agreement ok"
