@@ -20,7 +20,10 @@ Config (JSON):
   "peers": {"1": "127.0.0.1:7001", ...},
   "keys": "keys.json",            // from keygen
   "rbc": true,                     // Bracha reliable broadcast stage
-  "verifier": "device",            // "device" | "cpu" | "none"
+  "verifier": "device",            // "device" | "cpu" | "remote" | "none"
+  "verify_bucket": 16384,          // optional: fixed dispatch bucket
+  "verify_depth": 2,               // optional: in-flight dispatch window
+  "verify_warmup": true,           // AOT-compile the bucket at startup
   "coin": "threshold_bls",         // | "round_robin" | "fixed"
   "coin_msm": "host",              // "device": share aggregation on the mesh
 
@@ -187,9 +190,26 @@ class Node:
         verifier = None
         kind = cfg.get("verifier", "device")
         if kind == "device":
+            # Production entry-path parity with bench/tests: repo-local
+            # XLA compile cache, then wrap the device verifier in a
+            # depth-K dispatch window whose construction AOT-compiles
+            # the fixed-bucket program — the first consensus round must
+            # not eat a cold ~35 s XLA compile.
+            from dag_rider_tpu.utils.jaxcache import enable_persistent_cache
+            from dag_rider_tpu.verifier.pipeline import VerifierPipeline
             from dag_rider_tpu.verifier.tpu import TPUVerifier
 
-            verifier = TPUVerifier(reg)
+            enable_persistent_cache()
+            base = TPUVerifier(reg)
+            bucket = cfg.get("verify_bucket")
+            if bucket:
+                base.fixed_bucket = int(bucket)
+            depth = cfg.get("verify_depth")
+            verifier = VerifierPipeline(
+                base,
+                depth=int(depth) if depth else None,
+                warmup=bool(cfg.get("verify_warmup", True)),
+            )
         elif kind == "cpu":
             from dag_rider_tpu.verifier.cpu import CPUVerifier
 

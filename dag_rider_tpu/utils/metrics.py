@@ -35,9 +35,17 @@ class Metrics:
             maxlen=SAMPLE_WINDOW
         )
         self._last_wave_commit_at: float | None = None
+        #: in-flight dispatch window high-water per coalesced verify
+        #: cycle (depth-K pipeline — verifier/pipeline.py)
+        self.verify_queue_depth: Deque[int] = deque(maxlen=SAMPLE_WINDOW)
         #: exact running totals (never windowed) — the sums consumers use
         self.verify_sigs_total = 0
         self.verify_seconds_total = 0.0
+        #: host/device overlap accounting for the pipelined verify seam:
+        #: wait = host blocked in resolve (unhidden device time), seam =
+        #: verify-seam wall time. overlap_fraction() = 1 - wait/seam.
+        self.verify_wait_seconds_total = 0.0
+        self.verify_seam_seconds_total = 0.0
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -47,6 +55,34 @@ class Metrics:
         self.verify_batch_seconds.append(seconds)
         self.verify_sigs_total += size
         self.verify_seconds_total += seconds
+
+    def observe_verify_queue_depth(self, depth: int) -> None:
+        """High-water in-flight dispatch count of one coalesced verify
+        cycle (1 = the serial dispatch-then-resolve shape; >= 2 means
+        host prep genuinely overlapped device execution)."""
+        self.verify_queue_depth.append(depth)
+
+    def observe_verify_overlap(self, wait_s: float, seam_s: float) -> None:
+        """This process's share of a pipelined cycle: seconds the host
+        blocked in resolve vs the cycle's verify-seam wall time."""
+        self.verify_wait_seconds_total += wait_s
+        self.verify_seam_seconds_total += seam_s
+
+    def overlap_fraction(self) -> float | None:
+        """Fraction of verify-seam wall time the host spent doing useful
+        work (prep of later chunks, delivery walks) instead of blocked
+        on the device. None until a pipelined cycle ran."""
+        if self.verify_seam_seconds_total <= 0.0:
+            return None
+        return max(
+            0.0,
+            min(
+                1.0,
+                1.0
+                - self.verify_wait_seconds_total
+                / self.verify_seam_seconds_total,
+            ),
+        )
 
     def observe_wave_commit(self, seconds: float) -> None:
         """Duration of one decided wave's commit + total-order pass (the
@@ -88,6 +124,13 @@ class Metrics:
             out["verify_batch_p50_ms"] = 1e3 * self._p50(self.verify_batch_seconds)
             out["verify_batch_mean_size"] = sum(self.verify_batch_sizes) / len(
                 self.verify_batch_sizes
+            )
+        if self.verify_queue_depth:
+            out["verify_queue_depth_p50"] = self._p50(self.verify_queue_depth)
+            out["verify_queue_depth_max"] = max(self.verify_queue_depth)
+        if self.verify_seam_seconds_total > 0.0:
+            out["verify_overlap_fraction"] = round(
+                self.overlap_fraction(), 4
             )
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)

@@ -1,5 +1,6 @@
 from dag_rider_tpu.verifier.base import KeyRegistry, Verifier, VertexSigner
 from dag_rider_tpu.verifier.cpu import CPUVerifier, NullVerifier
+from dag_rider_tpu.verifier.pipeline import VerifierPipeline
 
 __all__ = [
     "KeyRegistry",
@@ -7,4 +8,5 @@ __all__ = [
     "VertexSigner",
     "CPUVerifier",
     "NullVerifier",
+    "VerifierPipeline",
 ]

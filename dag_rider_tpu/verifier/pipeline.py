@@ -1,0 +1,242 @@
+"""Depth-K asynchronous verifier pipeline.
+
+BENCH_r05 measured the device seam at 228.5 sigs/s with 179 ms per
+dispatch over 72 dispatches: the FIXED per-dispatch cost (H2D transfer,
+cache lookup, blocking resolve immediately after dispatch) dominates, not
+the math. The async halves already exist (``TPUVerifier.dispatch_batch``
+/ ``resolve_batch``) but every caller used them at depth 1 — dispatch,
+one slice of host work, resolve — and ``verify_rounds`` fell back to a
+fully synchronous chunk loop.
+
+:class:`VerifierPipeline` owns the in-flight window those halves imply:
+
+- **coalescing** — a merged burst (the simulator's per-pump union of all
+  n processes' ``take_verify_batch`` output, already deduped) is sliced
+  into ``fixed_bucket``-sized chunks, one compiled program shape for the
+  whole run;
+- **depth-K window** — up to K chunk dispatches stay in flight, so chunk
+  k+1's host prep (SHA-512 challenge scalars, limb packing — the
+  expensive host half) overlaps chunk k's device execution;
+- **FIFO resolve** — masks come back in submission order, and each chunk
+  boundary is identical to the synchronous path's, so the concatenated
+  mask — and therefore the commit order downstream of it — is
+  byte-identical to ``verify_batch`` / ``CPUVerifier``
+  (tests/test_pipeline.py);
+- **AOT warmup** — construction calls the verifier's :meth:`warmup`,
+  which ``jit(...).lower(...).compile()``-s the fixed-bucket program so
+  the first consensus round never eats a ~35 s XLA compile.
+
+The mask is still a pure function of (vertex bytes, registry); the
+pipeline only changes WHEN the host blocks, never WHAT it computes.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional, Sequence
+
+from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.verifier.base import Verifier
+
+
+def default_depth() -> int:
+    """In-flight window depth: DAGRIDER_VERIFY_DEPTH, default 2.
+
+    Depth 1 degenerates to the synchronous dispatch-then-resolve shape;
+    2 is enough to overlap host prep with device execution (the two
+    alternate); deeper windows only help when chunk execution time
+    varies."""
+    raw = os.environ.get("DAGRIDER_VERIFY_DEPTH", "").strip()
+    depth = int(raw) if raw else 2
+    if depth < 1:
+        raise ValueError(f"DAGRIDER_VERIFY_DEPTH must be >= 1, got {raw!r}")
+    return depth
+
+
+class VerifierPipeline(Verifier):
+    """Depth-K dispatch window over an async-capable verifier.
+
+    Wraps any verifier exposing the ``dispatch_batch``/``resolve_batch``
+    seam (``TPUVerifier`` and subclasses) and is itself a drop-in
+    :class:`Verifier`: ``verify_batch``/``verify_rounds`` stream through
+    the window, so a :class:`~dag_rider_tpu.consensus.process.Process`
+    can hold a pipeline directly (node.py's device configuration).
+    """
+
+    def __init__(
+        self,
+        verifier,
+        depth: Optional[int] = None,
+        *,
+        fixed_bucket: Optional[int] = None,
+        warmup: bool = True,
+    ):
+        if not callable(getattr(verifier, "dispatch_batch", None)) or not (
+            callable(getattr(verifier, "resolve_batch", None))
+        ):
+            raise TypeError(
+                "VerifierPipeline needs an async-capable verifier "
+                "(dispatch_batch/resolve_batch)"
+            )
+        self.verifier = verifier
+        # explicit depth > the verifier's own pipeline_depth > env default
+        self.depth = (
+            int(depth)
+            if depth is not None
+            else int(getattr(verifier, "pipeline_depth", 0) or default_depth())
+        )
+        if self.depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth!r}")
+        # the verifier sizes its host staging ring from pipeline_depth —
+        # it must cover THIS window or a slot could be rewritten while
+        # its dispatch is still in flight (CPU PJRT may alias host
+        # buffers zero-copy into the program)
+        if getattr(verifier, "pipeline_depth", self.depth) < self.depth:
+            verifier.pipeline_depth = self.depth
+        if fixed_bucket is not None:
+            verifier.fixed_bucket = fixed_bucket
+        self._inflight: Deque[tuple] = deque()
+        #: cumulative window accounting (the bench's amortization gauges)
+        self.dispatches = 0
+        self.sigs_dispatched = 0
+        self.wait_s = 0.0  # host blocked in resolve (unhidden device time)
+        self.seam_s = 0.0  # verify-seam wall time, overlap callback excluded
+        self.depth_hwm = 0  # high-water in-flight count
+        #: most recent run_coalesced cycle (the simulator's per-cycle share)
+        self.last_seam_s = 0.0
+        self.last_wait_s = 0.0
+        self.last_max_depth = 0
+        self.warmup_compile_s = 0.0
+        if warmup and hasattr(verifier, "warmup"):
+            self.warmup_compile_s = verifier.warmup()
+
+    # -- passthroughs: tune the wrapped verifier through the pipeline ----
+
+    @property
+    def fixed_bucket(self) -> Optional[int]:
+        return getattr(self.verifier, "fixed_bucket", None)
+
+    @fixed_bucket.setter
+    def fixed_bucket(self, value: Optional[int]) -> None:
+        self.verifier.fixed_bucket = value
+
+    @property
+    def registry(self):
+        return self.verifier.registry
+
+    # -- window mechanics ------------------------------------------------
+
+    def _dispatch(self, chunk: Sequence[Vertex]) -> None:
+        self._inflight.append(self.verifier.dispatch_batch(chunk))
+        self.dispatches += 1
+        self.sigs_dispatched += len(chunk)
+        d = len(self._inflight)
+        if d > self.depth_hwm:
+            self.depth_hwm = d
+        if d > self.last_max_depth:
+            self.last_max_depth = d
+
+    def _resolve_oldest(self) -> List[bool]:
+        t0 = time.perf_counter()
+        out = self.verifier.resolve_batch(self._inflight.popleft())
+        dt = time.perf_counter() - t0
+        self.wait_s += dt
+        self.last_wait_s += dt
+        # device share of the verifier's cumulative seam breakdown (its
+        # own sync verify_batch books the same quantity for itself)
+        if hasattr(self.verifier, "total_dispatch_s"):
+            self.verifier.total_dispatch_s += dt
+        return out
+
+    def run_coalesced(
+        self,
+        vertices: Sequence[Vertex],
+        overlap: Optional[Callable[[], None]] = None,
+    ) -> List[bool]:
+        """One coalesced cycle: chunk ``vertices`` at the verifier's
+        fixed bucket, stream the chunks through the depth-K window, run
+        ``overlap()`` once after the last dispatch (host work with no
+        causal dependency on the in-flight masks — the simulator's
+        deferred delivery flush), resolve FIFO, return the full mask.
+
+        Chunk boundaries are exactly ``verify_rounds``' synchronous
+        boundaries, so padding — and therefore the mask — is
+        byte-identical to the serial path. ``seam_s``/``last_seam_s``
+        exclude the overlap callback's duration (the callee accounts for
+        its own time)."""
+        t0 = time.perf_counter()
+        self.last_wait_s = 0.0
+        self.last_max_depth = len(self._inflight)
+        # pipeline_enabled off (bench's sync A/B side) caps the window at
+        # 1: dispatch-then-resolve, the pre-pipeline serial shape
+        depth = (
+            self.depth
+            if getattr(self.verifier, "pipeline_enabled", True)
+            else 1
+        )
+        cap = getattr(self.verifier, "fixed_bucket", None) or len(vertices)
+        cap = max(int(cap), 1)
+        mask: List[bool] = []
+        for i in range(0, len(vertices), cap):
+            while len(self._inflight) >= depth:
+                mask.extend(self._resolve_oldest())
+            self._dispatch(vertices[i : i + cap])
+        overlap_s = 0.0
+        if overlap is not None:
+            t1 = time.perf_counter()
+            overlap()
+            overlap_s = time.perf_counter() - t1
+        while self._inflight:
+            mask.extend(self._resolve_oldest())
+        self.last_seam_s = max(0.0, (time.perf_counter() - t0) - overlap_s)
+        self.seam_s += self.last_seam_s
+        return mask
+
+    # -- Verifier interface ----------------------------------------------
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        if not vertices:
+            return []
+        return self.run_coalesced(list(vertices))
+
+    def verify_rounds(
+        self, rounds: Sequence[Sequence[Vertex]]
+    ) -> List[List[bool]]:
+        lens = [len(r) for r in rounds]
+        flat = [v for r in rounds for v in r]
+        mask = self.run_coalesced(flat) if flat else []
+        out, pos = [], 0
+        for ln in lens:
+            out.append(mask[pos : pos + ln])
+            pos += ln
+        return out
+
+    # -- gauges ----------------------------------------------------------
+
+    def overlap_fraction(self) -> Optional[float]:
+        """Share of the verify seam's wall time during which the host was
+        doing useful work instead of blocked on the device:
+        ``1 - wait_s / seam_s``. 0 ~= the serial dispatch-then-resolve
+        shape; higher = more of the device time hidden behind host prep
+        and delivery walks. None until something ran."""
+        if self.seam_s <= 0.0:
+            return None
+        return max(0.0, min(1.0, 1.0 - self.wait_s / self.seam_s))
+
+    def stats(self) -> dict:
+        return {
+            "depth": self.depth,
+            "queue_depth_max": self.depth_hwm,
+            "dispatches": self.dispatches,
+            "sigs_dispatched": self.sigs_dispatched,
+            "wait_s": round(self.wait_s, 4),
+            "seam_s": round(self.seam_s, 4),
+            "overlap_fraction": (
+                None
+                if self.overlap_fraction() is None
+                else round(self.overlap_fraction(), 3)
+            ),
+            "warmup_compile_s": round(self.warmup_compile_s, 2),
+        }

@@ -76,9 +76,27 @@ class VerifierSidecarServer:
     lives on the same machine/pod as the consensus host; transport auth is
     a deployment concern layered via gRPC creds if needed)."""
 
-    def __init__(self, backend: Verifier, listen_addr: str = "127.0.0.1:0"):
+    def __init__(
+        self,
+        backend: Verifier,
+        listen_addr: str = "127.0.0.1:0",
+        *,
+        warmup: bool = True,
+    ):
         from concurrent import futures
 
+        # Device-backed sidecars get entry-path parity with bench/tests:
+        # the repo-local XLA compile cache plus an AOT warmup of the
+        # fixed-bucket program BEFORE the port opens, so the first
+        # VerifyBatch RPC never eats a cold ~35 s XLA compile. Host-only
+        # backends (CPUVerifier oracle) skip both — no jax import.
+        self.warmup_compile_s = 0.0
+        if hasattr(backend, "warmup"):
+            from dag_rider_tpu.utils.jaxcache import enable_persistent_cache
+
+            enable_persistent_cache()
+            if warmup:
+                self.warmup_compile_s = backend.warmup()
         # one worker: device dispatches serialize anyway, and a single
         # thread keeps per-backend batching deterministic.
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=1))

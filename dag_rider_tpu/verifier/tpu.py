@@ -204,6 +204,19 @@ def _device_verify_comb8(
     )
 
 
+#: Donated twins of the comb entry points for the AOT-warmed path
+#: (TPUVerifier.warmup): the input arrays are freshly device_put, used
+#: exactly once, so XLA may alias their buffers for outputs/temps
+#: instead of allocating — the comb tables persist across dispatches
+#: and stay undonated.
+_device_verify_comb_aot = functools.partial(
+    jax.jit, static_argnames=("impl",), donate_argnums=(0, 1)
+)(_device_verify_comb.__wrapped__)
+_device_verify_comb8_aot = functools.partial(
+    jax.jit, static_argnames=("impl",), donate_argnums=(0, 1)
+)(_device_verify_comb8.__wrapped__)
+
+
 _B_TABLE_CACHED: Optional[np.ndarray] = None
 
 
@@ -288,6 +301,18 @@ class TPUVerifier(Verifier):
             )
         self._comb_bits = int(bits_env) if bits_env else 4
         self._key_tables = None  # device tables, built lazily
+        # AOT-compiled executables keyed (size, impl, bits) — see warmup()
+        self._aot: dict = {}
+        # reusable host staging rings per padded size — see _stage()
+        self._staging: dict = {}
+        self._staging_idx: dict = {}
+        from dag_rider_tpu.verifier.pipeline import default_depth
+
+        #: in-flight window depth for the chunk-streaming verify_rounds
+        #: path (and the default for wrapping VerifierPipelines)
+        self.pipeline_depth = default_depth()
+        #: cumulative seconds spent in warmup()'s AOT lower+compile
+        self.warmup_compile_s = 0.0
         self.registry = registry
         n = registry.n
         self._a_x = np.zeros((n, field.LIMBS), dtype=np.int32)
@@ -307,7 +332,11 @@ class TPUVerifier(Verifier):
     # -- host-side batch preparation ------------------------------------
 
     def _prepare(
-        self, vertices: Sequence[Vertex], size: int, comb: bool = False
+        self,
+        vertices: Sequence[Vertex],
+        size: int,
+        comb: bool = False,
+        out: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ) -> Tuple[np.ndarray, ...]:
         # Vectorized host prep (round-2 VERDICT weak #3: the per-vertex
         # Python loop must clear ~50k iterations/s at the north-star rate).
@@ -378,15 +407,24 @@ class TPUVerifier(Verifier):
             # (PROFILE.md round 3). u8 carries digits + flag bits; i32
             # carries key index + R.y limbs. 8-bit windows ship the raw
             # scalar bytes; 4-bit ships nibble digits.
+            # every row and column below is fully overwritten, so the
+            # caller may hand in a reused staging pair (out=) — see
+            # _stage() for the aliasing discipline
             if self._comb_bits == 8:
-                u8 = np.empty((size, 67), dtype=np.uint8)
+                u8, i32 = out if out is not None else (
+                    np.empty((size, 67), dtype=np.uint8),
+                    np.empty((size, 23), dtype=np.int32),
+                )
                 u8[:, :32] = np.where(prevalid[:, None], s_raw, 0)
                 u8[:, 32:64] = k_raw
                 u8[:, 64] = r_sign
                 u8[:, 65] = prevalid
                 u8[:, 66] = self._a_valid[src] & prevalid
             else:
-                u8 = np.empty((size, 131), dtype=np.uint8)
+                u8, i32 = out if out is not None else (
+                    np.empty((size, 131), dtype=np.uint8),
+                    np.empty((size, 23), dtype=np.int32),
+                )
                 u8[:, :64] = nibbles_batch(
                     np.where(prevalid[:, None], s_raw, 0)
                 )
@@ -394,7 +432,6 @@ class TPUVerifier(Verifier):
                 u8[:, 128] = r_sign
                 u8[:, 129] = prevalid
                 u8[:, 130] = self._a_valid[src] & prevalid
-            i32 = np.empty((size, 23), dtype=np.int32)
             i32[:, 0] = src
             i32[:, 1:] = r_y_limbs
             return (u8, i32)
@@ -435,6 +472,76 @@ class TPUVerifier(Verifier):
             self._key_tables = jax.jit(comb.pad_rows)(built)
         return self._key_tables, self._b_table_dev
 
+    def _stage(self, size: int, cols: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Reusable (u8, i32) host staging pair for one dispatch.
+
+        A small ring instead of a fresh np.empty per dispatch: the CPU
+        PJRT client may alias a host array zero-copy into the program, so
+        a slot must not be rewritten while a dispatch that shipped it can
+        still be executing. The ring holds pipeline_depth + 2 slots and
+        every supported window keeps at most pipeline_depth dispatches in
+        flight, so a slot's previous dispatch has always resolved before
+        the slot comes around again."""
+        ring = self._staging.get(size)
+        if (
+            ring is None
+            or ring[0][0].shape[1] != cols
+            or len(ring) < self.pipeline_depth + 2
+        ):
+            ring = [
+                (
+                    np.empty((size, cols), dtype=np.uint8),
+                    np.empty((size, 23), dtype=np.int32),
+                )
+                for _ in range(self.pipeline_depth + 2)
+            ]
+            self._staging[size] = ring
+            self._staging_idx[size] = 0
+        i = self._staging_idx[size]
+        self._staging_idx[size] = (i + 1) % len(ring)
+        return ring[i]
+
+    def warmup(self, bucket: Optional[int] = None) -> float:
+        """AOT-compile the fixed-bucket device program:
+        ``jit(...).lower(...).compile()`` at the exact (bucket, impl,
+        window-bits) shape, stored for dispatch_batch to call directly.
+
+        Run at construction time (VerifierPipeline), node startup, and
+        VerifierSidecarServer startup so the first consensus round never
+        eats the ~35 s XLA compile; with the repo-local persistent cache
+        enabled the lower+compile is a disk hit after the first ever run.
+        Returns the seconds spent (cumulative in ``warmup_compile_s``).
+        The windowed (comb=False) oracle path keeps its lazy jit cache —
+        it is never on the hot path."""
+        if not self._comb:
+            return 0.0
+        size = int(bucket or self.fixed_bucket or _MIN_BUCKET)
+        impl = _comb_impl(size)
+        key = (size, impl, self._comb_bits)
+        if key in self._aot:
+            return 0.0
+        t0 = time.perf_counter()
+        tables, b_tab = self._comb_tables()
+        # the CPU client cannot alias these buffers (XLA warns and
+        # ignores the donation) — donate only where it actually lands
+        donate = jax.default_backend() != "cpu"
+        if self._comb_bits == 8:
+            cols = 67
+            fn = _device_verify_comb8_aot if donate else _device_verify_comb8
+        else:
+            cols = 131
+            fn = _device_verify_comb_aot if donate else _device_verify_comb
+        self._aot[key] = fn.lower(
+            jax.ShapeDtypeStruct((size, cols), jnp.uint8),
+            jax.ShapeDtypeStruct((size, 23), jnp.int32),
+            tables,
+            b_tab,
+            impl=impl,
+        ).compile()
+        dt = time.perf_counter() - t0
+        self.warmup_compile_s += dt
+        return dt
+
     #: host-prep / device-dispatch seconds of the most recent
     #: verify_batch call — the host/device split the bench reports.
     last_prepare_s: float = 0.0
@@ -457,6 +564,15 @@ class TPUVerifier(Verifier):
     #: of ~35 s XLA compiles as burst sizes wander (bench ladder sim64).
     fixed_bucket: Optional[int] = None
 
+    #: Explicit A/B switch for the async seam. False forces every
+    #: consumer (Simulation.run, the chunk-streaming verify_rounds, a
+    #: wrapping VerifierPipeline) onto the synchronous depth-1
+    #: dispatch-then-resolve shape — the bench's pipeline-off rung.
+    #: Replaces the round-5 instance-attribute None shadow of
+    #: dispatch_batch/resolve_batch (and the _unshadowed MRO walk that
+    #: let verify_batch reach past it).
+    pipeline_enabled: bool = True
+
     def dispatch_batch(self, vertices: Sequence[Vertex]):
         """Asynchronous half of verify: host prep + device dispatch, NO
         sync. Returns an opaque (device_mask, count) pending handle for
@@ -469,7 +585,12 @@ class TPUVerifier(Verifier):
             size = _bucket(len(vertices))
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.prepare"):
-            args = self._prepare(vertices, size, comb=self._comb)
+            out = (
+                self._stage(size, 67 if self._comb_bits == 8 else 131)
+                if self._comb
+                else None
+            )
+            args = self._prepare(vertices, size, comb=self._comb, out=out)
         self.last_prepare_s = time.perf_counter() - t0
         self.total_prepare_s += self.last_prepare_s
         self.total_dispatches += 1
@@ -478,18 +599,28 @@ class TPUVerifier(Verifier):
             if self._comb:
                 u8, i32 = args
                 tables, b_tab = self._comb_tables()
-                fn = (
-                    _device_verify_comb8
-                    if self._comb_bits == 8
-                    else _device_verify_comb
-                )
-                mask = fn(
-                    jnp.asarray(u8),
-                    jnp.asarray(i32),
-                    tables,
-                    b_tab,
-                    impl=_comb_impl(size),
-                )
+                impl = _comb_impl(size)
+                exe = self._aot.get((size, impl, self._comb_bits))
+                if exe is not None:
+                    # AOT path (warmup()): committed single-use device
+                    # buffers into the donated executable — no jit-cache
+                    # lookup, and XLA reuses the input allocations
+                    mask = exe(
+                        jax.device_put(u8), jax.device_put(i32), tables, b_tab
+                    )
+                else:
+                    fn = (
+                        _device_verify_comb8
+                        if self._comb_bits == 8
+                        else _device_verify_comb
+                    )
+                    mask = fn(
+                        jnp.asarray(u8),
+                        jnp.asarray(i32),
+                        tables,
+                        b_tab,
+                        impl=impl,
+                    )
             else:
                 mask = _device_verify(*(jnp.asarray(a) for a in args))
         return mask, len(vertices)
@@ -505,6 +636,13 @@ class TPUVerifier(Verifier):
         padded dispatch and splitting the mask after. Used by the bench's
         merged steady-state phase and available to catch-up sync / burst
         consumers.
+
+        Merges larger than the fixed bucket STREAM their chunks through
+        the async seam with a depth-K in-flight window (K =
+        pipeline_depth; 1 when pipeline_enabled is off): chunk k+1's
+        host prep overlaps chunk k's device execution instead of the old
+        serial dispatch-then-resolve loop. Chunk boundaries and FIFO
+        resolve order are unchanged, so the mask stays byte-identical.
         """
         lens = [len(r) for r in rounds]
         flat = [v for r in rounds for v in r]
@@ -512,9 +650,17 @@ class TPUVerifier(Verifier):
             return [[] for _ in rounds]
         cap = self.fixed_bucket
         if cap and len(flat) > cap:
+            from collections import deque
+
+            depth = self.pipeline_depth if self.pipeline_enabled else 1
+            inflight: deque = deque()
             mask = []
             for i in range(0, len(flat), cap):
-                mask.extend(self.verify_batch(flat[i : i + cap]))
+                while len(inflight) >= depth:
+                    mask.extend(self._resolve_timed(inflight.popleft()))
+                inflight.append(self.dispatch_batch(flat[i : i + cap]))
+            while inflight:
+                mask.extend(self._resolve_timed(inflight.popleft()))
         else:
             mask = self.verify_batch(flat)
         out, pos = [], 0
@@ -529,31 +675,20 @@ class TPUVerifier(Verifier):
         mask, count = pending
         return [bool(m) for m in np.asarray(mask)[:count]]
 
-    def _unshadowed(self, name: str):
-        """The class-level method behind an instance-attribute shadow,
-        bound correctly whether it is defined as a staticmethod or an
-        instance method (the descriptor handles both)."""
-        for klass in type(self).__mro__:
-            if name in klass.__dict__:
-                return klass.__dict__[name].__get__(self, type(self))
-        raise AttributeError(name)
-
-    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
-        if not vertices:
-            return []
-        # Trace annotations are free when no profiler is attached; under
-        # jax.profiler.trace() (bench.py DAGRIDER_PROFILE_DIR / SURVEY §5)
-        # they label the host-prep vs device-dispatch split per round.
-        #
-        # Callers measuring the pipeline OFF (bench sim256_sync) shadow
-        # dispatch_batch/resolve_batch with instance-level None so the
-        # simulator takes its synchronous branch; reach past the shadow
-        # to the class methods here — verify_batch IS the sync path.
-        dispatch = self.dispatch_batch or self._unshadowed("dispatch_batch")
-        resolve = self.resolve_batch or self._unshadowed("resolve_batch")
-        pending = dispatch(vertices)
+    def _resolve_timed(self, pending) -> List[bool]:
+        """resolve_batch plus the device-seconds accounting the seam
+        breakdown expects (verify_batch and the chunk-streaming
+        verify_rounds both resolve through here)."""
         t0 = time.perf_counter()
-        out = resolve(pending)
+        out = self.resolve_batch(pending)
         self.last_dispatch_s = time.perf_counter() - t0
         self.total_dispatch_s += self.last_dispatch_s
         return out
+
+    def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
+        # Trace annotations are free when no profiler is attached; under
+        # jax.profiler.trace() (bench.py DAGRIDER_PROFILE_DIR / SURVEY §5)
+        # they label the host-prep vs device-dispatch split per round.
+        if not vertices:
+            return []
+        return self._resolve_timed(self.dispatch_batch(vertices))

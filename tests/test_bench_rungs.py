@@ -51,12 +51,12 @@ def test_sim_rung_extends_past_box_until_target_met():
 
 
 def test_sim_rung_pipeline_off_runs_and_restores_seam():
-    """The pipeline-off B side must run the synchronous path (round-5
-    regression: the None shadow crashed verify_batch mid-ladder) and
-    restore the async seam afterwards. Byte-identity of the two paths
-    is covered deterministically by test_determinism.py::
-    test_pipelined_coalesced_path_matches_sync_path — a wall-clock
-    time-boxed rung pair cannot assert equality."""
+    """The pipeline-off B side must run the synchronous path via the
+    pipeline_enabled flag (which replaced the round-5 None shadow whose
+    crash truncated a ladder) and restore the flag afterwards.
+    Byte-identity of the two paths is covered deterministically by
+    test_determinism.py::test_pipelined_coalesced_path_matches_sync_path
+    — a wall-clock time-boxed rung pair cannot assert equality."""
     v, signers = _built()
     e_on = bench._sim_rung(8, 1.5, v, signers, bucket=256, chunk=56)
     e_off = bench._sim_rung(
@@ -64,5 +64,11 @@ def test_sim_rung_pipeline_off_runs_and_restores_seam():
     )
     assert e_on["pipelined"] is True and e_off["pipelined"] is False
     assert e_off["messages"] > 0 and e_off["max_round"] >= 1
-    # shadow cleaned up: the async seam is live again
-    assert v.dispatch_batch is not None and v.resolve_batch is not None
+    # the A side reports the window gauges; the B side reads empty
+    assert e_on["verifier_breakdown"]["queue_depth"] >= 1
+    assert 0.0 <= e_on["verifier_breakdown"]["overlap_fraction"] <= 1.0
+    assert e_off["verifier_breakdown"]["queue_depth_max"] == 0
+    # flag restored: the async seam is live again
+    assert v.pipeline_enabled is True
+    pending = v.dispatch_batch([])
+    assert v.resolve_batch(pending) == []

@@ -151,23 +151,26 @@ def test_commit_order_byte_identical_cpu_vs_tpu():
     assert logs["cpu"] == logs["tpu"]
 
 
-def test_verify_batch_survives_pipeline_off_shadow(keys, signed_vertices):
-    """bench.py's sim256_sync rung shadows dispatch_batch/resolve_batch
-    with instance-level None to force the simulator's synchronous branch;
-    verify_batch must reach past the shadow to the class methods (round-5
-    regression: the shadow made verify_batch call None and killed the
-    measure stage mid-ladder)."""
+def test_verify_batch_survives_pipeline_off_flag(keys, signed_vertices):
+    """bench.py's sim256_sync rung flips pipeline_enabled False to force
+    the synchronous depth-1 path (this flag replaced the round-5
+    instance-attribute None shadow, whose failure mode was verify_batch
+    calling None mid-ladder); verify_batch and the chunked verify_rounds
+    must keep working — and produce identical masks — in both states."""
     reg, _ = keys
     v = TPUVerifier(reg)
+    v.fixed_bucket = 16
     baseline = v.verify_batch(signed_vertices)
-    v.dispatch_batch = None
-    v.resolve_batch = None
+    rounds_base = v.verify_rounds([signed_vertices, signed_vertices])
+    v.pipeline_enabled = False
     try:
         assert v.verify_batch(signed_vertices) == baseline
+        assert v.verify_rounds([signed_vertices, signed_vertices]) == (
+            rounds_base
+        )
         assert all(baseline)
     finally:
-        del v.dispatch_batch
-        del v.resolve_batch
-    # the shadow is gone: the async seam is usable again
+        v.pipeline_enabled = True
+    # flag restored: the async seam is usable again
     pending = v.dispatch_batch(signed_vertices)
     assert v.resolve_batch(pending) == baseline
