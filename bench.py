@@ -405,6 +405,15 @@ def _sim_rung(
             "warmup_compile_s": round(
                 getattr(verifier, "warmup_compile_s", 0.0) - warm0, 2
             ),
+            # mesh placement gauges (ShardedTPUVerifier; 1/0/0.0 on the
+            # single-chip path): devices the dispatch laid out over,
+            # per-shard rows of the last dispatch, and its shard fill
+            # imbalance (0.0 = every shard carried equal real rows)
+            "mesh_devices": getattr(verifier, "mesh_devices", 1),
+            "shard_batch": getattr(verifier, "last_shard_batch", 0),
+            "shard_imbalance": round(
+                getattr(verifier, "last_shard_imbalance", 0.0), 3
+            ),
         },
     }
 
@@ -992,6 +1001,97 @@ def _measure() -> None:
             _mark("ladder verify1024: warm batch failed, discarding")
     else:
         _mark(f"skipping ladder verify1024 (left {left():.0f}s)")
+
+    # -- ladder rung #6 (round 7): mesh-sharded comb verify at the
+    # flagship n=256, driven through the FULL async seam (warmup +
+    # dispatch/resolve via VerifierPipeline) — sigs/s at 1 device vs the
+    # mesh, same signatures, masks checked identical. When a real
+    # multi-device mesh exists the record also refreshes
+    # MULTICHIP_r06.json so the smoke file becomes a scaling curve.
+    if os.environ.get("DAGRIDER_BENCH_SHARDED", "1") == "1" and left() > 120:
+        try:
+            from dag_rider_tpu.parallel.mesh import mesh_from_env
+            from dag_rider_tpu.parallel.sharded_verifier import (
+                ShardedTPUVerifier,
+            )
+            from dag_rider_tpu.verifier.pipeline import VerifierPipeline
+
+            mesh = mesh_from_env()
+            n_dev = int(np.prod(mesh.devices.shape))
+            n = 256
+            if n in built:
+                single, sbatches, _ = built[n]
+                sbatches = sbatches[:4]
+            else:
+                _mark("ladder verify_n256_sharded: signing 4 rounds")
+                single, sbatches, _ = _build_batches(n, 4)
+            s_total = sum(len(b) for b in sbatches)
+            s_bucket = 256
+            _mark(
+                f"ladder verify_n256_sharded: {n_dev}-device mesh, "
+                f"{s_total} sigs, bucket {s_bucket}"
+            )
+
+            def _timed_pipe(v):
+                v.fixed_bucket = s_bucket
+                pipe = VerifierPipeline(v, depth=2, warmup=True)
+                masks = pipe.verify_rounds(sbatches)  # compile + warm
+                times = []
+                for _ in range(3):
+                    t0 = time.monotonic()
+                    masks = pipe.verify_rounds(sbatches)
+                    times.append(time.monotonic() - t0)
+                return masks, min(times)
+
+            one_masks, one_dt = _timed_pipe(single)
+            sharded = ShardedTPUVerifier(single.registry, mesh)
+            mesh_masks, mesh_dt = _timed_pipe(sharded)
+            match = mesh_masks == one_masks and all(
+                all(m) for m in mesh_masks
+            )
+            entry = {
+                "nodes": n,
+                "sigs": s_total,
+                "devices": n_dev,
+                "bucket": s_bucket,
+                "pipeline_depth": 2,
+                "single_device_sigs_per_sec": round(s_total / one_dt, 1),
+                "sharded_sigs_per_sec": round(s_total / mesh_dt, 1),
+                "speedup": round(one_dt / mesh_dt, 2),
+                "shard_batch": sharded.last_shard_batch,
+                "shard_imbalance": round(sharded.last_shard_imbalance, 3),
+                "masks_match": match,
+            }
+            result["ladder"]["verify_n256_sharded"] = entry
+            _mark(
+                f"ladder verify_n256_sharded: 1-dev "
+                f"{s_total / one_dt:,.0f} sigs/s vs {n_dev}-dev "
+                f"{s_total / mesh_dt:,.0f} sigs/s "
+                f"(x{one_dt / mesh_dt:.2f}, match={match})"
+            )
+            emit()
+            if match and n_dev > 1:
+                rec = dict(entry)
+                rec.update(
+                    backend=backend,
+                    device_kind=device_kind,
+                    ok=True,
+                    skipped=False,
+                )
+                out_path = os.path.join(
+                    _REPO,
+                    os.environ.get(
+                        "DAGRIDER_MULTICHIP_OUT", "MULTICHIP_r06.json"
+                    ),
+                )
+                with open(out_path, "w") as fh:
+                    json.dump(rec, fh, indent=1)
+                    fh.write("\n")
+                _mark(f"ladder verify_n256_sharded: wrote {out_path}")
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder verify_n256_sharded FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder verify_n256_sharded (left {left():.0f}s)")
 
     # -- ladder rung #5 (single-host half): T-point G1 MSM on the device
     msm_t = int(os.environ.get("DAGRIDER_BENCH_MSM_T", "1024"))

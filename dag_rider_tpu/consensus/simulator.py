@@ -32,12 +32,21 @@ class Simulation:
         *,
         transport: Optional[Transport] = None,
         coin_factory: Optional[Callable[[int], CommonCoin]] = None,
+        verifier: Optional[str] = None,
         verifier_factory: Optional[Callable[[int], object]] = None,
         signer_factory: Optional[Callable[[int], object]] = None,
         rbc: bool = False,
         log=None,
     ) -> None:
         self.cfg = cfg
+        if verifier is not None:
+            if verifier_factory is not None:
+                raise ValueError(
+                    "pass verifier= or verifier_factory=, not both"
+                )
+            verifier_factory, signer_factory = self._named_verifier(
+                verifier, signer_factory
+            )
         self.transport = transport if transport is not None else InMemoryTransport()
         self.deliveries: List[List[Vertex]] = [[] for _ in range(cfg.n)]
         #: depth-K dispatch window over the shared verifier, built lazily
@@ -71,6 +80,41 @@ class Simulation:
                     log=log if log is not None else NOOP,
                 )
             )
+
+    def _named_verifier(self, kind: str, signer_factory):
+        """Convenience spelling of the common cluster shapes:
+        ``verifier="cpu" | "device" | "sharded"`` builds one SHARED
+        verifier (the coalesced-dispatch configuration Simulation.run
+        optimizes for) over a deterministic committee registry, plus the
+        matching signer factory when the caller didn't bring one — so a
+        CPU-oracle run and a sharded run of the same Config verify the
+        exact same signatures and their commit orders are comparable
+        byte for byte. "sharded" takes its mesh from DAGRIDER_MESH (or
+        the virtual-device fallback — parallel/mesh.mesh_from_env)."""
+        from dag_rider_tpu.verifier.base import KeyRegistry, VertexSigner
+
+        reg, seeds = KeyRegistry.generate(self.cfg.n)
+        if kind == "cpu":
+            from dag_rider_tpu.verifier.cpu import CPUVerifier
+
+            shared = CPUVerifier(reg)
+        elif kind == "device":
+            from dag_rider_tpu.verifier.tpu import TPUVerifier
+
+            shared = TPUVerifier(reg)
+        elif kind == "sharded":
+            from dag_rider_tpu.parallel.mesh import mesh_from_env
+            from dag_rider_tpu.parallel.sharded_verifier import (
+                ShardedTPUVerifier,
+            )
+
+            shared = ShardedTPUVerifier(reg, mesh_from_env())
+        else:
+            raise ValueError(f"unknown verifier {kind!r}")
+        if signer_factory is None:
+            signers = [VertexSigner(s) for s in seeds]
+            signer_factory = lambda i: signers[i]  # noqa: E731
+        return (lambda i: shared), signer_factory
 
     @staticmethod
     def _dedup(flat):
@@ -243,6 +287,13 @@ class Simulation:
                                     p.metrics.observe_verify_overlap(
                                         pipe.last_wait_s * share,
                                         verify_s * share,
+                                    )
+                                if getattr(shared, "mesh_devices", 0):
+                                    # mesh-sharded dispatch: how evenly
+                                    # the cycle's last chunk filled the
+                                    # shards (ShardedTPUVerifier gauge)
+                                    p.metrics.observe_shard_imbalance(
+                                        shared.last_shard_imbalance
                                     )
                                 pos += len(b)
                             # empty batches advance nothing

@@ -20,7 +20,7 @@ Config (JSON):
   "peers": {"1": "127.0.0.1:7001", ...},
   "keys": "keys.json",            // from keygen
   "rbc": true,                     // Bracha reliable broadcast stage
-  "verifier": "device",            // "device" | "cpu" | "remote" | "none"
+  "verifier": "device",            // | "sharded" | "cpu" | "remote" | "none"
   "verify_bucket": 16384,          // optional: fixed dispatch bucket
   "verify_depth": 2,               // optional: in-flight dispatch window
   "verify_warmup": true,           // AOT-compile the bucket at startup
@@ -189,18 +189,31 @@ class Node:
 
         verifier = None
         kind = cfg.get("verifier", "device")
-        if kind == "device":
+        if kind in ("device", "sharded"):
             # Production entry-path parity with bench/tests: repo-local
             # XLA compile cache, then wrap the device verifier in a
             # depth-K dispatch window whose construction AOT-compiles
             # the fixed-bucket program — the first consensus round must
-            # not eat a cold ~35 s XLA compile.
+            # not eat a cold ~35 s XLA compile. "sharded" shares every
+            # knob (verify_bucket/verify_depth/verify_warmup) and lays
+            # the batch over a device mesh sized by DAGRIDER_MESH
+            # (virtual-device fallback on CPU — parallel/mesh.py); its
+            # bucket rounds up to a mesh multiple internally, masks stay
+            # byte-identical to the single-chip program.
             from dag_rider_tpu.utils.jaxcache import enable_persistent_cache
             from dag_rider_tpu.verifier.pipeline import VerifierPipeline
             from dag_rider_tpu.verifier.tpu import TPUVerifier
 
             enable_persistent_cache()
-            base = TPUVerifier(reg)
+            if kind == "sharded":
+                from dag_rider_tpu.parallel.mesh import mesh_from_env
+                from dag_rider_tpu.parallel.sharded_verifier import (
+                    ShardedTPUVerifier,
+                )
+
+                base = ShardedTPUVerifier(reg, mesh_from_env())
+            else:
+                base = TPUVerifier(reg)
             bucket = cfg.get("verify_bucket")
             if bucket:
                 base.fixed_bucket = int(bucket)

@@ -11,10 +11,40 @@ hand-written NCCL/MPI analog to port.
 
 from __future__ import annotations
 
+import os
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=)``; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` — the same
+    static varying-axis check under its old name. Every shard_map in this
+    package (sharded comb verify, sharded MSM) goes through here so the
+    mesh paths run on both the chip host's jax and the 0.4.x CI/test
+    containers."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+    )
 
 
 def make_mesh(
@@ -37,6 +67,47 @@ def make_mesh(
     import numpy as np
 
     return Mesh(np.asarray(devs).reshape(shape), axis_names)
+
+
+def mesh_from_env(default_devices: int = 8) -> Mesh:
+    """The 1-D batch mesh for ``verifier: "sharded"`` deployments.
+
+    ``DAGRIDER_MESH`` gives the batch-axis device count; unset means
+    every visible device. On a CPU backend that has not been initialized
+    yet (laptops, CI), the XLA host-device-count flag is injected first
+    so the request still yields a real multi-device mesh — the virtual
+    8-device fallback the tests run on. If jax already initialized with
+    fewer devices than requested, the mesh clamps with a warning rather
+    than failing the node."""
+    raw = os.environ.get("DAGRIDER_MESH", "").strip()
+    want = int(raw) if raw else None
+    if want is not None and want < 1:
+        raise ValueError(f"DAGRIDER_MESH must be >= 1, got {raw!r}")
+    platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (
+        platform.lower() == "cpu"
+        and "xla_force_host_platform_device_count" not in flags
+    ):
+        # Before the first jax.devices() call this flag still takes
+        # effect; after backend init it is ignored and the clamp below
+        # applies. Only the CPU platform honors it at all.
+        virtual = want if want is not None else default_devices
+        if virtual > 1:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={virtual}"
+            ).strip()
+    have = jax.device_count()
+    if want is None:
+        want = have
+    if want > have:
+        warnings.warn(
+            f"DAGRIDER_MESH={want} but only {have} device(s) visible; "
+            f"clamping the mesh to {have}",
+            stacklevel=2,
+        )
+        want = have
+    return make_mesh(want)
 
 
 def batch_sharding(mesh: Mesh, axis: str = "batch") -> NamedSharding:

@@ -38,6 +38,12 @@ class Metrics:
         #: in-flight dispatch window high-water per coalesced verify
         #: cycle (depth-K pipeline — verifier/pipeline.py)
         self.verify_queue_depth: Deque[int] = deque(maxlen=SAMPLE_WINDOW)
+        #: per-dispatch shard fill imbalance on the mesh-sharded verify
+        #: path (0.0 = every shard got equal real rows; 1.0 = at least
+        #: one shard was all padding while another was full)
+        self.verify_shard_imbalance: Deque[float] = deque(
+            maxlen=SAMPLE_WINDOW
+        )
         #: exact running totals (never windowed) — the sums consumers use
         self.verify_sigs_total = 0
         self.verify_seconds_total = 0.0
@@ -61,6 +67,13 @@ class Metrics:
         cycle (1 = the serial dispatch-then-resolve shape; >= 2 means
         host prep genuinely overlapped device execution)."""
         self.verify_queue_depth.append(depth)
+
+    def observe_shard_imbalance(self, fraction: float) -> None:
+        """Shard fill imbalance of one mesh-sharded dispatch
+        ((max - min real rows per shard) / shard rows — 0.0 when the
+        batch filled every shard equally). Persistent high values mean
+        the bucket is oversized for the burst and chips idle on padding."""
+        self.verify_shard_imbalance.append(fraction)
 
     def observe_verify_overlap(self, wait_s: float, seam_s: float) -> None:
         """This process's share of a pipelined cycle: seconds the host
@@ -128,6 +141,13 @@ class Metrics:
         if self.verify_queue_depth:
             out["verify_queue_depth_p50"] = self._p50(self.verify_queue_depth)
             out["verify_queue_depth_max"] = max(self.verify_queue_depth)
+        if self.verify_shard_imbalance:
+            out["verify_shard_imbalance_p50"] = round(
+                self._p50(self.verify_shard_imbalance), 4
+            )
+            out["verify_shard_imbalance_max"] = round(
+                max(self.verify_shard_imbalance), 4
+            )
         if self.verify_seam_seconds_total > 0.0:
             out["verify_overlap_fraction"] = round(
                 self.overlap_fraction(), 4

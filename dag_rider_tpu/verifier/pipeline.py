@@ -226,7 +226,7 @@ class VerifierPipeline(Verifier):
         return max(0.0, min(1.0, 1.0 - self.wait_s / self.seam_s))
 
     def stats(self) -> dict:
-        return {
+        out = {
             "depth": self.depth,
             "queue_depth_max": self.depth_hwm,
             "dispatches": self.dispatches,
@@ -240,3 +240,14 @@ class VerifierPipeline(Verifier):
             ),
             "warmup_compile_s": round(self.warmup_compile_s, 2),
         }
+        # mesh gauges when the wrapped verifier dispatches sharded
+        # (ShardedTPUVerifier): devices, per-shard rows of the latest
+        # dispatch, and its shard fill imbalance (0.0 = every shard full)
+        mesh_devices = getattr(self.verifier, "mesh_devices", 0)
+        if mesh_devices:
+            out["mesh_devices"] = mesh_devices
+            out["shard_batch"] = getattr(self.verifier, "last_shard_batch", 0)
+            out["shard_imbalance"] = round(
+                getattr(self.verifier, "last_shard_imbalance", 0.0), 3
+            )
+        return out

@@ -501,6 +501,71 @@ class TPUVerifier(Verifier):
         self._staging_idx[size] = (i + 1) % len(ring)
         return ring[i]
 
+    # -- dispatch seam hooks ---------------------------------------------
+    # dispatch_batch/warmup route every placement-sensitive decision
+    # through these overridables, so ShardedTPUVerifier (parallel/
+    # sharded_verifier.py) inherits the async/AOT/staging machinery —
+    # padding, chunk boundaries, FIFO resolve — unchanged, and only the
+    # placement (mesh-rounded buckets, NamedSharding device_put, the
+    # shard_map program, mesh-keyed AOT entries) differs. The mask stays
+    # a pure function of (vertex bytes, registry) under every override.
+
+    def _round_bucket(self, b: int) -> int:
+        """Final padded-size adjustment (mesh subclasses round up to a
+        multiple of the batch axis; single-chip is the identity)."""
+        return int(b)
+
+    def _select_impl(self, size: int) -> str:
+        """Comb tree engine for a padded dispatch of ``size`` rows."""
+        return _comb_impl(size)
+
+    def _aot_key(self, size: int, impl: str) -> tuple:
+        """Cache key for the AOT-compiled program at this shape."""
+        return (size, impl, self._comb_bits)
+
+    def _put(self, arr: np.ndarray) -> jax.Array:
+        """Host staging array -> committed device input."""
+        return jax.device_put(arr)
+
+    def _comb_tables_dev(self):
+        """(key_tables, b_table) placed where the dispatch needs them."""
+        return self._comb_tables()
+
+    def _comb_fn(self, impl: str):
+        """The lazily-jitted comb entry point (non-AOT dispatches)."""
+        fn = (
+            _device_verify_comb8
+            if self._comb_bits == 8
+            else _device_verify_comb
+        )
+        return functools.partial(fn, impl=impl)
+
+    def _windowed_dispatch(self, args) -> jax.Array:
+        """The comb=False oracle path's device call."""
+        return _device_verify(*(jnp.asarray(a) for a in args))
+
+    def _aot_lower(self, size: int, impl: str, tables, b_tab):
+        """lower+compile the comb program at the exact dispatch shape."""
+        # the CPU client cannot alias these buffers (XLA warns and
+        # ignores the donation) — donate only where it actually lands
+        donate = jax.default_backend() != "cpu"
+        if self._comb_bits == 8:
+            cols = 67
+            fn = _device_verify_comb8_aot if donate else _device_verify_comb8
+        else:
+            cols = 131
+            fn = _device_verify_comb_aot if donate else _device_verify_comb
+        return fn.lower(
+            jax.ShapeDtypeStruct((size, cols), jnp.uint8),
+            jax.ShapeDtypeStruct((size, 23), jnp.int32),
+            tables,
+            b_tab,
+            impl=impl,
+        ).compile()
+
+    def _note_dispatch(self, size: int, count: int) -> None:
+        """Per-dispatch gauge hook (mesh subclasses book shard balance)."""
+
     def warmup(self, bucket: Optional[int] = None) -> float:
         """AOT-compile the fixed-bucket device program:
         ``jit(...).lower(...).compile()`` at the exact (bucket, impl,
@@ -515,29 +580,16 @@ class TPUVerifier(Verifier):
         it is never on the hot path."""
         if not self._comb:
             return 0.0
-        size = int(bucket or self.fixed_bucket or _MIN_BUCKET)
-        impl = _comb_impl(size)
-        key = (size, impl, self._comb_bits)
+        size = self._round_bucket(
+            int(bucket or self.fixed_bucket or _MIN_BUCKET)
+        )
+        impl = self._select_impl(size)
+        key = self._aot_key(size, impl)
         if key in self._aot:
             return 0.0
         t0 = time.perf_counter()
-        tables, b_tab = self._comb_tables()
-        # the CPU client cannot alias these buffers (XLA warns and
-        # ignores the donation) — donate only where it actually lands
-        donate = jax.default_backend() != "cpu"
-        if self._comb_bits == 8:
-            cols = 67
-            fn = _device_verify_comb8_aot if donate else _device_verify_comb8
-        else:
-            cols = 131
-            fn = _device_verify_comb_aot if donate else _device_verify_comb
-        self._aot[key] = fn.lower(
-            jax.ShapeDtypeStruct((size, cols), jnp.uint8),
-            jax.ShapeDtypeStruct((size, 23), jnp.int32),
-            tables,
-            b_tab,
-            impl=impl,
-        ).compile()
+        tables, b_tab = self._comb_tables_dev()
+        self._aot[key] = self._aot_lower(size, impl, tables, b_tab)
         dt = time.perf_counter() - t0
         self.warmup_compile_s += dt
         return dt
@@ -580,9 +632,9 @@ class TPUVerifier(Verifier):
         with round k's device execution — the steady-state pipeline shape
         of burst delivery (one dispatch per DAG round)."""
         if self.fixed_bucket and len(vertices) <= self.fixed_bucket:
-            size = self.fixed_bucket
+            size = self._round_bucket(int(self.fixed_bucket))
         else:
-            size = _bucket(len(vertices))
+            size = self._round_bucket(_bucket(len(vertices)))
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("verify_batch.prepare"):
             out = (
@@ -595,34 +647,24 @@ class TPUVerifier(Verifier):
         self.total_prepare_s += self.last_prepare_s
         self.total_dispatches += 1
         self.total_sigs_dispatched += len(vertices)
+        self._note_dispatch(size, len(vertices))
         with jax.profiler.TraceAnnotation("verify_batch.dispatch"):
             if self._comb:
                 u8, i32 = args
-                tables, b_tab = self._comb_tables()
-                impl = _comb_impl(size)
-                exe = self._aot.get((size, impl, self._comb_bits))
+                tables, b_tab = self._comb_tables_dev()
+                impl = self._select_impl(size)
+                exe = self._aot.get(self._aot_key(size, impl))
                 if exe is not None:
                     # AOT path (warmup()): committed single-use device
                     # buffers into the donated executable — no jit-cache
                     # lookup, and XLA reuses the input allocations
-                    mask = exe(
-                        jax.device_put(u8), jax.device_put(i32), tables, b_tab
-                    )
+                    mask = exe(self._put(u8), self._put(i32), tables, b_tab)
                 else:
-                    fn = (
-                        _device_verify_comb8
-                        if self._comb_bits == 8
-                        else _device_verify_comb
-                    )
-                    mask = fn(
-                        jnp.asarray(u8),
-                        jnp.asarray(i32),
-                        tables,
-                        b_tab,
-                        impl=impl,
+                    mask = self._comb_fn(impl)(
+                        self._put(u8), self._put(i32), tables, b_tab
                     )
             else:
-                mask = _device_verify(*(jnp.asarray(a) for a in args))
+                mask = self._windowed_dispatch(args)
         return mask, len(vertices)
 
     def verify_rounds(
