@@ -292,6 +292,13 @@ def _sim_rung(
         getattr(verifier, "total_dispatches", 0),
         getattr(verifier, "total_sigs_dispatched", 0),
     )
+    # host-prep engine row counters BEFORE the box, for a rung-local
+    # parallel fraction (prep_stats' own fraction is engine-lifetime)
+    ps0 = (
+        verifier.prep_stats()
+        if callable(getattr(verifier, "prep_stats", None))
+        else None
+    )
     try:
         t0 = _t.monotonic()
         pumped = 0
@@ -335,6 +342,18 @@ def _sim_rung(
     d_disp = getattr(verifier, "total_dispatch_s", 0.0) - tot0[1]
     d_count = getattr(verifier, "total_dispatches", 0) - tot0[2]
     d_sigs = getattr(verifier, "total_sigs_dispatched", 0) - tot0[3]
+    if ps0 is not None:
+        ps1 = verifier.prep_stats()
+        d_rows = ps1["rows_total"] - ps0["rows_total"]
+        d_rows_par = ps1["rows_parallel"] - ps0["rows_parallel"]
+        prep_gauges = {
+            "prep_workers": ps1["workers"],
+            "prep_parallel_fraction": (
+                round(d_rows_par / d_rows, 3) if d_rows > 0 else 0.0
+            ),
+        }
+    else:
+        prep_gauges = {"prep_workers": 1, "prep_parallel_fraction": 0.0}
     return {
         "nodes": n,
         "coin": entry_coin,
@@ -380,6 +399,10 @@ def _sim_rung(
         # message pump)
         "verifier_breakdown": {
             "prepare_s": round(d_prep, 2),
+            # LOWER BOUND on pipelined runs (ADVICE r5 #1): device time
+            # hidden under the delivery-flush window or later chunks'
+            # host prep never blocks resolve and books ~0 here — only
+            # UNHIDDEN device time is measured
             "device_s": round(d_disp, 2),
             "host_other_s": round(max(0.0, dt - d_prep - d_disp), 2),
             "dispatches": d_count,
@@ -414,6 +437,10 @@ def _sim_rung(
             "shard_imbalance": round(
                 getattr(verifier, "last_shard_imbalance", 0.0), 3
             ),
+            # parallel host-prep engine gauges (verifier/prep.py):
+            # configured worker count + share of this rung's prepped
+            # rows that took the row-block parallel path
+            **prep_gauges,
         },
     }
 
@@ -709,64 +736,73 @@ def _measure() -> None:
         # phase — restore its bucket after the rungs, or a 512-bucket
         # sim leaves verify_rounds chunking the "merged" dispatch
         prev_bucket = verifier.fixed_bucket
-        if sim256_bucket != 16384:
-            # a non-default bucket is a NEW program shape — compile it
-            # OUTSIDE the timed box (the 16384 default reuses the merged
-            # headline phase's program; sim64 pre-warms the same way)
-            _mark(f"ladder sim256: pre-warming bucket-{sim256_bucket} program")
-            verifier.fixed_bucket = sim256_bucket
-            verifier.warmup()  # AOT: jit().lower().compile() at the shape
-            verifier.verify_batch(built[256][1][0][:9])  # host-prep warm
-        entry = _sim_rung(
-            256,
-            sim256_budget,
-            verifier,
-            signers,
-            bucket=sim256_bucket,
-            chunk=256 * 255,
-            coin="threshold_bls",
-        )
-        entry["bucket"] = sim256_bucket
-        result["ladder"]["sim256"] = entry
-        # the official end-to-end p50 at the north-star committee size
-        if entry["wave_commit_p50_ms"] is not None:
-            result["wave_commit_p50_ms"] = entry["wave_commit_p50_ms"]
-        _mark(
-            f"ladder sim256: {entry['sigs_applied']} applied sigs "
-            f"({entry['sigs_applied_per_sec']:,.0f}/s; device "
-            f"{entry['sigs_device_per_sec']:,.0f}/s), "
-            f"{entry['vertices_delivered_total']} delivered, "
-            f"round {entry['max_round']}, "
-            f"wave p50 {entry['wave_commit_p50_ms']} ms"
-        )
-        emit()
-        # before/after overlap evidence (round-4 VERDICT #4): the same
-        # rung with the dispatch/delivery pipeline forced OFF — the p50
-        # delta is what the overlap buys at the north-star committee
-        sync_budget = float(
-            os.environ.get("DAGRIDER_BENCH_SIM256_SYNC_S", "25")
-        )
-        if sync_budget > 0 and left() > sync_budget + 30:
-            _mark(f"ladder sim256_sync: {sync_budget:.0f}s, pipeline OFF")
+        # try/finally (ADVICE r5 #3): an exception anywhere in the two
+        # rungs must not leak a sim-sized bucket into the deferred
+        # merged headline phase sharing this verifier
+        try:
+            if sim256_bucket != 16384:
+                # a non-default bucket is a NEW program shape — compile
+                # it OUTSIDE the timed box (the 16384 default reuses the
+                # merged headline phase's program; sim64 pre-warms the
+                # same way)
+                _mark(
+                    f"ladder sim256: pre-warming bucket-{sim256_bucket} program"
+                )
+                verifier.fixed_bucket = sim256_bucket
+                verifier.warmup()  # AOT: jit().lower().compile() at the shape
+                verifier.verify_batch(built[256][1][0][:9])  # host-prep warm
             entry = _sim_rung(
                 256,
-                sync_budget,
+                sim256_budget,
                 verifier,
                 signers,
-                bucket=sim256_bucket,  # same program as the A side
+                bucket=sim256_bucket,
                 chunk=256 * 255,
                 coin="threshold_bls",
-                pipelined=False,
             )
             entry["bucket"] = sim256_bucket
-            result["ladder"]["sim256_sync"] = entry
+            result["ladder"]["sim256"] = entry
+            # the official end-to-end p50 at the north-star committee size
+            if entry["wave_commit_p50_ms"] is not None:
+                result["wave_commit_p50_ms"] = entry["wave_commit_p50_ms"]
             _mark(
-                f"ladder sim256_sync: wave p50 "
-                f"{entry['wave_commit_p50_ms']} ms "
-                f"({entry['sigs_applied_per_sec']:,.0f} applied sigs/s)"
+                f"ladder sim256: {entry['sigs_applied']} applied sigs "
+                f"({entry['sigs_applied_per_sec']:,.0f}/s; device "
+                f"{entry['sigs_device_per_sec']:,.0f}/s), "
+                f"{entry['vertices_delivered_total']} delivered, "
+                f"round {entry['max_round']}, "
+                f"wave p50 {entry['wave_commit_p50_ms']} ms"
             )
             emit()
-        verifier.fixed_bucket = prev_bucket
+            # before/after overlap evidence (round-4 VERDICT #4): the
+            # same rung with the dispatch/delivery pipeline forced OFF —
+            # the p50 delta is what the overlap buys at the north-star
+            # committee
+            sync_budget = float(
+                os.environ.get("DAGRIDER_BENCH_SIM256_SYNC_S", "25")
+            )
+            if sync_budget > 0 and left() > sync_budget + 30:
+                _mark(f"ladder sim256_sync: {sync_budget:.0f}s, pipeline OFF")
+                entry = _sim_rung(
+                    256,
+                    sync_budget,
+                    verifier,
+                    signers,
+                    bucket=sim256_bucket,  # same program as the A side
+                    chunk=256 * 255,
+                    coin="threshold_bls",
+                    pipelined=False,
+                )
+                entry["bucket"] = sim256_bucket
+                result["ladder"]["sim256_sync"] = entry
+                _mark(
+                    f"ladder sim256_sync: wave p50 "
+                    f"{entry['wave_commit_p50_ms']} ms "
+                    f"({entry['sigs_applied_per_sec']:,.0f} applied sigs/s)"
+                )
+                emit()
+        finally:
+            verifier.fixed_bucket = prev_bucket
     else:
         _mark(f"skipping ladder sim256 (left {left():.0f}s)")
 
@@ -1092,6 +1128,113 @@ def _measure() -> None:
             _mark(f"ladder verify_n256_sharded FAILED: {e!r}")
     else:
         _mark(f"skipping ladder verify_n256_sharded (left {left():.0f}s)")
+
+    # -- ladder rung #7 (round 8): parallel host-prep 1-vs-N A/B at the
+    # flagship n=256, through the FULL async seam (VerifierPipeline,
+    # depth 2 — prep runs on the engine's seam thread, row-blocked
+    # across the pool). On the CPU backend the device program dominates
+    # wall clock, so the rung's headline is host_prep_ms_per_round on
+    # both sides — a wall-clock tie with a prep-ms drop is the expected
+    # CPU shape; on a real chip the prep drop surfaces in sigs/s.
+    if (
+        os.environ.get("DAGRIDER_BENCH_PREP", "1") == "1"
+        and left() > 90
+        and 256 in built
+    ):
+        try:
+            from dag_rider_tpu.verifier.pipeline import VerifierPipeline
+            from dag_rider_tpu.verifier.prep import default_prep_workers
+
+            verifier, pbatches, _ = built[256]
+            pbatches = pbatches[:4]
+            p_total = sum(len(b) for b in pbatches)
+            p_workers = int(
+                os.environ.get("DAGRIDER_BENCH_PREP_WORKERS", "0")
+            ) or min(4, os.cpu_count() or 1)
+            _mark(
+                f"ladder verify_n256_prep: {p_total} sigs, bucket 256, "
+                f"workers 1 vs {p_workers}"
+            )
+            prev_bucket = verifier.fixed_bucket
+            prev_workers = verifier.prep_workers
+            try:
+                verifier.fixed_bucket = 256  # same program shape as the
+                # sharded rung's single-device side (already compiled
+                # when that rung ran; persistent cache otherwise)
+                pipe = VerifierPipeline(verifier, depth=2, warmup=True)
+                sides = {}
+                masks_by_side = {}
+                for w in dict.fromkeys((1, p_workers)):
+                    verifier.prep_workers = w
+                    pipe.verify_rounds(pbatches)  # warm: pool + program
+                    ps0 = verifier.prep_stats()
+                    prep0 = verifier.total_prepare_s
+                    times = []
+                    for _ in range(3):
+                        t0 = time.monotonic()
+                        masks_by_side[w] = pipe.verify_rounds(pbatches)
+                        times.append(time.monotonic() - t0)
+                    ps1 = verifier.prep_stats()
+                    d_prep = verifier.total_prepare_s - prep0
+                    d_rows = ps1["rows_total"] - ps0["rows_total"]
+                    d_par = ps1["rows_parallel"] - ps0["rows_parallel"]
+                    sides[w] = {
+                        "prep_workers": w,
+                        "host_prep_ms_per_round": round(
+                            1e3 * d_prep / (3 * len(pbatches)), 3
+                        ),
+                        "sigs_per_sec": round(3 * p_total / sum(times), 1),
+                        "wall_s": round(min(times), 3),
+                        "parallel_fraction": (
+                            round(d_par / d_rows, 3) if d_rows else 0.0
+                        ),
+                    }
+                serial, par = sides[1], sides[p_workers]
+                match = all(
+                    m == masks_by_side[1] for m in masks_by_side.values()
+                ) and all(all(r) for r in masks_by_side[1])
+                entry = {
+                    "nodes": 256,
+                    "sigs": p_total,
+                    "bucket": 256,
+                    "pipeline_depth": 2,
+                    "serial": serial,
+                    "parallel": par,
+                    "prep_speedup": (
+                        round(
+                            serial["host_prep_ms_per_round"]
+                            / par["host_prep_ms_per_round"],
+                            2,
+                        )
+                        if par["host_prep_ms_per_round"]
+                        else None
+                    ),
+                    "masks_match": match,
+                }
+                result["ladder"]["verify_n256_prep"] = entry
+                _mark(
+                    f"ladder verify_n256_prep: prep "
+                    f"{serial['host_prep_ms_per_round']} ms/round @1w vs "
+                    f"{par['host_prep_ms_per_round']} ms/round "
+                    f"@{p_workers}w (x{entry['prep_speedup']}, "
+                    f"match={match})"
+                )
+                emit()
+            finally:
+                # restore the shared verifier for the deferred merged
+                # headline phase: bucket back, engine back to the env
+                # default (leaving prep_workers None would pin the LAST
+                # A/B side's pool)
+                verifier.prep_workers = (
+                    prev_workers
+                    if prev_workers is not None
+                    else default_prep_workers()
+                )
+                verifier.fixed_bucket = prev_bucket
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder verify_n256_prep FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder verify_n256_prep (left {left():.0f}s)")
 
     # -- ladder rung #5 (single-host half): T-point G1 MSM on the device
     msm_t = int(os.environ.get("DAGRIDER_BENCH_MSM_T", "1024"))

@@ -250,7 +250,16 @@ class Simulation:
                             # observes it into the wave-commit metric —
                             # charging it here too would double-count);
                             # the pipeline books its resolve waits into
-                            # the verifier's cumulative breakdown itself
+                            # the verifier's cumulative breakdown itself.
+                            # NOTE (ADVICE r5 #1): with the window open,
+                            # the resolve waits the pipeline books as
+                            # device time are a LOWER BOUND — device
+                            # execution that completes under the flush
+                            # window (or under later chunks' host prep)
+                            # never blocks resolve and reads ~0 there,
+                            # so verifier_breakdown's device_s
+                            # understates true device occupancy on
+                            # pipelined runs.
                             verify_s = pipe.last_seam_s
                         else:
                             with Timer() as t:
@@ -272,6 +281,13 @@ class Simulation:
                         # window gauges fan out the same way.
                         total = len(flat)
                         pos = 0
+                        # latest host-prep engine gauges, fanned out to
+                        # every participating process below
+                        ps = (
+                            shared.prep_stats()
+                            if callable(getattr(shared, "prep_stats", None))
+                            else None
+                        )
                         for p, b in zip(self.processes, batches):
                             if b:
                                 share = len(b) / total
@@ -280,6 +296,20 @@ class Simulation:
                                     mask[pos : pos + len(b)],
                                     verify_s * share,
                                 )
+                                if self.dedup:
+                                    # per-process verify timings are
+                                    # AMORTIZED under the dedup'd shared
+                                    # verifier: each process is charged
+                                    # its size-proportional share of one
+                                    # union dispatch, so the n series do
+                                    # not sum to n independent verify
+                                    # costs (ADVICE r5 #2)
+                                    p.metrics.mark_verify_amortized()
+                                if ps is not None:
+                                    p.metrics.observe_prep(
+                                        ps["workers"],
+                                        ps["parallel_fraction"],
+                                    )
                                 if pipelined:
                                     p.metrics.observe_verify_queue_depth(
                                         pipe.last_max_depth

@@ -38,6 +38,13 @@ class VertexID(NamedTuple):
     C — the frozen-dataclass version's __init__ + precomputed-hash dance
     was ~3 us per id and the single hottest allocation site of the
     n=256 host profile.
+
+    Being a NamedTuple, a VertexID hashes and compares equal to the bare
+    tuple ``(round, source)`` — INTENTIONAL (ADVICE r5 #4): hot paths
+    may probe dicts/sets keyed by VertexID with plain tuples (skipping
+    even the NamedTuple constructor) and membership answers must agree.
+    Do not "fix" this by overriding __eq__/__hash__; code must not rely
+    on the two being distinguishable.
     """
 
     round: int

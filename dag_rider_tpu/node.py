@@ -23,6 +23,7 @@ Config (JSON):
   "verifier": "device",            // | "sharded" | "cpu" | "remote" | "none"
   "verify_bucket": 16384,          // optional: fixed dispatch bucket
   "verify_depth": 2,               // optional: in-flight dispatch window
+  "verify_prep_workers": 4,        // optional: parallel host-prep workers
   "verify_warmup": true,           // AOT-compile the bucket at startup
   "coin": "threshold_bls",         // | "round_robin" | "fixed"
   "coin_msm": "host",              // "device": share aggregation on the mesh
@@ -217,6 +218,11 @@ class Node:
             bucket = cfg.get("verify_bucket")
             if bucket:
                 base.fixed_bucket = int(bucket)
+            # parallel host-prep engine (verifier/prep.py): explicit
+            # config beats the DAGRIDER_PREP_WORKERS env default
+            prep = cfg.get("verify_prep_workers")
+            if prep:
+                base.prep_workers = int(prep)
             depth = cfg.get("verify_depth")
             verifier = VerifierPipeline(
                 base,

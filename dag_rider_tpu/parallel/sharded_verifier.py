@@ -19,6 +19,13 @@ were silently inherited un-overridden and every async caller dispatched
 single-chip; the hook seam makes that fallback structurally impossible
 (tests/test_parallel.py asserts the dispatched mask spans the mesh).
 
+The round-8 parallel host-prep engine (verifier/prep.py) rides the same
+seam for free: ``prep_batch``/``prep_batch_async`` run entirely ABOVE the
+placement hooks (row blocks write into the host staging slot before
+``_put``/``_note_dispatch`` ever see it), so sharded dispatch gets
+multi-worker prep and prep-ahead with zero code here — the staging slot
+stays one full-batch host array and only `_put` splits it over the mesh.
+
 Byte-identical masks: chunk boundaries come from the caller-visible
 ``fixed_bucket`` exactly as on the single-chip path; only the PAD size of
 each dispatch rounds up to a multiple of the mesh batch axis, and padding

@@ -52,6 +52,11 @@ class Metrics:
         #: verify-seam wall time. overlap_fraction() = 1 - wait/seam.
         self.verify_wait_seconds_total = 0.0
         self.verify_seam_seconds_total = 0.0
+        #: parallel host-prep engine gauges (verifier/prep.py): worker
+        #: count of the shared verifier's engine and the lifetime share
+        #: of prepped rows that took the parallel row-block path
+        self.verify_prep_workers = 0
+        self.verify_prep_parallel_fraction: float | None = None
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -96,6 +101,24 @@ class Metrics:
                 / self.verify_seam_seconds_total,
             ),
         )
+
+    def observe_prep(self, workers: int, parallel_fraction: float) -> None:
+        """Latest host-prep engine gauges (TPUVerifier.prep_stats):
+        configured worker count and the fraction of all prepped rows
+        that actually ran row-block parallel — the no-silent-fallback
+        signal (workers > 1 with fraction 0.0 means every dispatch was
+        below the block floor or the engine never engaged)."""
+        self.verify_prep_workers = int(workers)
+        self.verify_prep_parallel_fraction = float(parallel_fraction)
+
+    def mark_verify_amortized(self) -> None:
+        """Flag this process's verify timings as AMORTIZED: under the
+        simulator's dedup'd shared verifier one process pays the wall
+        time for a union batch whose masks all n processes consume, so
+        per-process verify_seconds/sigs do not sum to cluster cost
+        (ADVICE r5 #2). Consumers must treat the per-process series as
+        attribution of shared work, not as independent spend."""
+        self.counters["verify_timings_amortized"] = 1
 
     def observe_wave_commit(self, seconds: float) -> None:
         """Duration of one decided wave's commit + total-order pass (the
@@ -151,6 +174,11 @@ class Metrics:
         if self.verify_seam_seconds_total > 0.0:
             out["verify_overlap_fraction"] = round(
                 self.overlap_fraction(), 4
+            )
+        if self.verify_prep_workers:
+            out["verify_prep_workers"] = self.verify_prep_workers
+            out["verify_prep_parallel_fraction"] = round(
+                self.verify_prep_parallel_fraction or 0.0, 4
             )
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)

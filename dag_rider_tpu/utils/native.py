@@ -101,6 +101,14 @@ def challenge_batch(
 
     rs/pks: uint8[n, 32]; msgs: n byte strings. Returns uint8[n, 32]
     little-endian scalars, or None when the native library is absent.
+
+    Thread-safe and re-entrant: every buffer the C call reads or writes
+    is allocated per call (the copies above this line are part of the
+    contract, not an optimization), the library keeps no global state,
+    and ctypes releases the GIL for the duration of the foreign call —
+    the parallel host-prep engine (verifier/prep.py) relies on exactly
+    this, invoking it concurrently from row-block worker threads so N
+    blocks hash in genuinely parallel native code.
     """
     lib = load()
     if lib is None:

@@ -130,8 +130,18 @@ class VerifierPipeline(Verifier):
 
     def _dispatch(self, chunk: Sequence[Vertex]) -> None:
         self._inflight.append(self.verifier.dispatch_batch(chunk))
+        self._book_dispatch(len(chunk))
+
+    def _dispatch_prepped(self, prepped) -> None:
+        """Ship a batch already prepped on the engine's seam thread
+        (TPUVerifier.prep_batch_async) — same window accounting as
+        _dispatch, prep already paid."""
+        self._inflight.append(self.verifier.dispatch_prepped(prepped))
+        self._book_dispatch(prepped.count)
+
+    def _book_dispatch(self, count: int) -> None:
         self.dispatches += 1
-        self.sigs_dispatched += len(chunk)
+        self.sigs_dispatched += count
         d = len(self._inflight)
         if d > self.depth_hwm:
             self.depth_hwm = d
@@ -179,10 +189,40 @@ class VerifierPipeline(Verifier):
         cap = getattr(self.verifier, "fixed_bucket", None) or len(vertices)
         cap = max(int(cap), 1)
         mask: List[bool] = []
-        for i in range(0, len(vertices), cap):
-            while len(self._inflight) >= depth:
-                mask.extend(self._resolve_oldest())
-            self._dispatch(vertices[i : i + cap])
+        chunks = [vertices[i : i + cap] for i in range(0, len(vertices), cap)]
+        async_prep = (
+            depth > 1
+            and len(chunks) > 1
+            and callable(getattr(self.verifier, "prep_batch_async", None))
+            and callable(getattr(self.verifier, "dispatch_prepped", None))
+        )
+        if async_prep:
+            # Prep-ahead on the engine's seam thread: chunk k+2's prep
+            # runs while chunk k+1's prep is queued behind it and chunk
+            # k executes on the device. At most 2 preps outstanding, and
+            # a new prep is submitted only AFTER the window has drained
+            # below depth and the current chunk has dispatched — so when
+            # prep j+2 claims staging slot (j+2) mod (pipeline_depth+2),
+            # that slot's previous dispatch (chunk <= j-depth) has
+            # already resolved.
+            preps: Deque = deque()
+            nxt = 0
+            while nxt < len(chunks) and len(preps) < 2:
+                preps.append(self.verifier.prep_batch_async(chunks[nxt]))
+                nxt += 1
+            while preps:
+                prepped = preps.popleft().result()
+                while len(self._inflight) >= depth:
+                    mask.extend(self._resolve_oldest())
+                self._dispatch_prepped(prepped)
+                if nxt < len(chunks):
+                    preps.append(self.verifier.prep_batch_async(chunks[nxt]))
+                    nxt += 1
+        else:
+            for chunk in chunks:
+                while len(self._inflight) >= depth:
+                    mask.extend(self._resolve_oldest())
+                self._dispatch(chunk)
         overlap_s = 0.0
         if overlap is not None:
             t1 = time.perf_counter()
@@ -240,6 +280,13 @@ class VerifierPipeline(Verifier):
             ),
             "warmup_compile_s": round(self.warmup_compile_s, 2),
         }
+        # host-prep engine gauges (round 8): worker count and the share
+        # of prepped rows that actually took the parallel row-block path
+        # — the structural no-silent-fallback signal
+        if callable(getattr(self.verifier, "prep_stats", None)):
+            ps = self.verifier.prep_stats()
+            out["prep_workers"] = ps["workers"]
+            out["prep_parallel_fraction"] = round(ps["parallel_fraction"], 3)
         # mesh gauges when the wrapped verifier dispatches sharded
         # (ShardedTPUVerifier): devices, per-shard rows of the latest
         # dispatch, and its shard fill imbalance (0.0 = every shard full)

@@ -20,7 +20,7 @@ TPU, and remote-TPU verifier placements.
 from __future__ import annotations
 
 import threading
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import grpc
 
@@ -82,9 +82,15 @@ class VerifierSidecarServer:
         listen_addr: str = "127.0.0.1:0",
         *,
         warmup: bool = True,
+        prep_workers: Optional[int] = None,
     ):
         from concurrent import futures
 
+        # Parallel host-prep engine (verifier/prep.py): an explicit
+        # worker count overrides the backend's env-derived default, set
+        # before warmup so the first prep builds the right pool.
+        if prep_workers is not None and hasattr(backend, "prep_workers"):
+            backend.prep_workers = int(prep_workers)
         # Device-backed sidecars get entry-path parity with bench/tests:
         # the repo-local XLA compile cache plus an AOT warmup of the
         # fixed-bucket program BEFORE the port opens, so the first

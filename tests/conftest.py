@@ -66,6 +66,10 @@ _SLOW = {
     "test_pipeline.py::test_sharded_pipeline_masks_byte_identical[16-1]",
     "test_pipeline.py::test_sharded_pipeline_masks_byte_identical[16-2]",
     "test_pipeline.py::test_sharded_pipeline_masks_byte_identical[16-4]",
+    # round-8 parallel host-prep engine, mesh side (tier1-mesh and
+    # tier1-prep CI lanes run these with the slow marker included)
+    "test_prep.py::test_sharded_prep_masks_byte_identical[2]",
+    "test_prep.py::test_sharded_prep_masks_byte_identical[4]",
     "test_pallas_group.py::test_finish_kernel_matches_jnp_tail",
     "test_pallas_group.py::test_pow22523_kernel_matches_field",
     "test_node.py::test_churn_restored_logs_stay_prefix_consistent",
