@@ -354,6 +354,20 @@ def _sim_rung(
         }
     else:
         prep_gauges = {"prep_workers": 1, "prep_parallel_fraction": 0.0}
+    # round-9 resilience gauges: containment/ladder counters of this
+    # rung's verify stack (all zero on a clean run — the chaos rung and
+    # ladder deployments are where they move)
+    rs_fn = getattr(
+        pipe if pipe is not None else verifier, "resilience_stats", None
+    )
+    rs = rs_fn() if callable(rs_fn) else {}
+    res_gauges = {
+        "verify_retries": rs.get("retries", 0),
+        "verify_fallback_tier": rs.get("fallback_tier", 0),
+        "verify_quarantined": rs.get("quarantined", 0),
+        "poisoned_windows": rs.get("poisoned_windows", 0),
+        "sidecar_rpc_failures": rs.get("sidecar_rpc_failures", 0),
+    }
     return {
         "nodes": n,
         "coin": entry_coin,
@@ -441,6 +455,8 @@ def _sim_rung(
             # configured worker count + share of this rung's prepped
             # rows that took the row-block parallel path
             **prep_gauges,
+            # fault-containment / degradation-ladder gauges (round 9)
+            **res_gauges,
         },
     }
 
@@ -1235,6 +1251,98 @@ def _measure() -> None:
             _mark(f"ladder verify_n256_prep FAILED: {e!r}")
     else:
         _mark(f"skipping ladder verify_n256_prep (left {left():.0f}s)")
+
+    # -- ladder rung #8 (round 9): verify under injected chaos at the
+    # flagship n=256, through the FULL async seam. Budgeted faults at
+    # the dispatch/resolve seams poison depth-2 windows mid-stream; the
+    # containment machinery must salvage, re-arm the ring and quarantine
+    # with masks IDENTICAL to the clean run — the rung's headline is the
+    # latency cost of containment (slowdown vs clean), never
+    # correctness. Quarantined chunks re-verify on a clean DEVICE
+    # verifier tier (the CPU reference would dominate the rung's wall
+    # clock on a 1-core host and measure the oracle, not containment).
+    if (
+        os.environ.get("DAGRIDER_BENCH_CHAOS", "1") == "1"
+        and left() > 60
+        and 256 in built
+    ):
+        try:
+            from dag_rider_tpu.verifier.faults import (
+                VerifierFaultInjector,
+                VerifierFaultPlan,
+            )
+            from dag_rider_tpu.verifier.pipeline import VerifierPipeline
+            from dag_rider_tpu.verifier.tpu import TPUVerifier as _ChaosTPUV
+
+            verifier, cbatches, _ = built[256]
+            cbatches = cbatches[:4]
+            c_total = sum(len(b) for b in cbatches)
+            _mark(
+                f"ladder verify_n256_chaos: {c_total} sigs, bucket 256, "
+                f"budgeted dispatch/resolve faults"
+            )
+            prev_bucket = verifier.fixed_bucket
+            inj = None
+            try:
+                verifier.fixed_bucket = 256
+                pipe = VerifierPipeline(verifier, depth=2, warmup=True)
+                pipe.verify_rounds(cbatches)  # warm program + ring
+                t0 = time.monotonic()
+                clean_masks = pipe.verify_rounds(cbatches)
+                clean_dt = time.monotonic() - t0
+
+                quarantine = _ChaosTPUV(verifier.registry)
+                quarantine.fixed_bucket = 256
+                quarantine.warmup()  # persistent-cache hit: same shape
+                pipe.quarantine_verifier = quarantine
+                inj = VerifierFaultInjector(
+                    VerifierFaultPlan(
+                        dispatch_raise=0.5,
+                        resolve_raise=0.5,
+                        max_faults=4,
+                        seed=9,
+                    )
+                )
+                inj.arm(verifier)
+                t0 = time.monotonic()
+                chaos_masks = pipe.verify_rounds(cbatches)
+                chaos_dt = time.monotonic() - t0
+            finally:
+                if inj is not None:
+                    inj.disarm()
+                verifier.fixed_bucket = prev_bucket
+            match = chaos_masks == clean_masks and all(
+                all(m) for m in clean_masks
+            )
+            rs = pipe.resilience_stats()
+            entry = {
+                "nodes": 256,
+                "sigs": c_total,
+                "bucket": 256,
+                "pipeline_depth": 2,
+                "clean_sigs_per_sec": round(c_total / clean_dt, 1),
+                "chaos_sigs_per_sec": round(c_total / chaos_dt, 1),
+                "containment_slowdown": round(chaos_dt / clean_dt, 2),
+                "faults_injected": inj.faults_injected,
+                "fault_stats": dict(inj.stats),
+                "poisoned_windows": rs["poisoned_windows"],
+                "verify_quarantined": rs["quarantined"],
+                "quarantine_rejected": rs["quarantine_rejected"],
+                "masks_match": match,
+            }
+            result["ladder"]["verify_n256_chaos"] = entry
+            _mark(
+                f"ladder verify_n256_chaos: clean "
+                f"{c_total / clean_dt:,.0f} sigs/s vs chaos "
+                f"{c_total / chaos_dt:,.0f} sigs/s "
+                f"(x{chaos_dt / clean_dt:.2f} slowdown, "
+                f"{inj.faults_injected} faults, match={match})"
+            )
+            emit()
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder verify_n256_chaos FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder verify_n256_chaos (left {left():.0f}s)")
 
     # -- ladder rung #5 (single-host half): T-point G1 MSM on the device
     msm_t = int(os.environ.get("DAGRIDER_BENCH_MSM_T", "1024"))

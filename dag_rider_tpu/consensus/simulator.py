@@ -288,6 +288,29 @@ class Simulation:
                             if callable(getattr(shared, "prep_stats", None))
                             else None
                         )
+                        # round-9 resilience gauges: from the window when
+                        # pipelined, else from the shared verifier itself
+                        # (a ResilientVerifier ladder takes the sync
+                        # verify_rounds path — its pipelining lives inside
+                        # the device tier). Fanned out when the stack IS
+                        # a ladder (zeros are meaningful there) or once
+                        # any fault was actually absorbed — a clean
+                        # non-resilient run keeps its snapshot unchanged.
+                        rs_fn = getattr(
+                            pipe if pipelined else shared,
+                            "resilience_stats",
+                            None,
+                        )
+                        rs = rs_fn() if callable(rs_fn) else None
+                        if rs is not None and not (
+                            hasattr(shared, "tier_health")
+                            or rs.get("retries")
+                            or rs.get("fallbacks")
+                            or rs.get("poisoned_windows")
+                            or rs.get("quarantined")
+                            or rs.get("sidecar_rpc_failures")
+                        ):
+                            rs = None
                         for p, b in zip(self.processes, batches):
                             if b:
                                 share = len(b) / total
@@ -309,6 +332,18 @@ class Simulation:
                                     p.metrics.observe_prep(
                                         ps["workers"],
                                         ps["parallel_fraction"],
+                                    )
+                                if rs is not None:
+                                    p.metrics.observe_resilience(
+                                        rs.get("retries", 0),
+                                        rs.get("fallback_tier", 0),
+                                        rs.get("quarantined", 0),
+                                        sidecar_health=rs.get(
+                                            "sidecar_health"
+                                        ),
+                                        rpc_failures=rs.get(
+                                            "sidecar_rpc_failures", 0
+                                        ),
                                     )
                                 if pipelined:
                                     p.metrics.observe_verify_queue_depth(
@@ -339,6 +374,13 @@ class Simulation:
                 if pipelined:
                     p.flush_deliveries()
                     p.defer_delivery = False
+            # chaos observability: a FaultyTransport's injected-fault
+            # counters land in every process's snapshot next to the
+            # verifier resilience gauges
+            tstats = getattr(self.transport, "stats", None)
+            if isinstance(tstats, dict):
+                for p in self.processes:
+                    p.metrics.observe_transport_faults(tstats)
         return delivered
 
     # -- assertions for tests ---------------------------------------------

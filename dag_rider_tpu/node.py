@@ -25,6 +25,12 @@ Config (JSON):
   "verify_depth": 2,               // optional: in-flight dispatch window
   "verify_prep_workers": 4,        // optional: parallel host-prep workers
   "verify_warmup": true,           // AOT-compile the bucket at startup
+  "verify_fallback": "cpu",        // optional: degradation-ladder floor
+                                   // under device/sharded/remote
+                                   // (default DAGRIDER_VERIFY_FALLBACK)
+  "verify_retry": 1,               // optional: retries per ladder tier /
+                                   // sidecar attempt resends
+                                   // (default DAGRIDER_VERIFY_RETRY)
   "coin": "threshold_bls",         // | "round_robin" | "fixed"
   "coin_msm": "host",              // "device": share aggregation on the mesh
 
@@ -190,6 +196,37 @@ class Node:
 
         verifier = None
         kind = cfg.get("verifier", "device")
+        # Round-9 resilience knobs. "verify_fallback": "cpu" ladders the
+        # configured verifier onto a CPUVerifier floor (ResilientVerifier:
+        # bounded per-tier retry, background health probe + promotion, a
+        # batch rejected only after the whole ladder fails).
+        # "verify_retry" is the per-tier retry count (and the sidecar's
+        # resend count for a bare "remote"). Explicit config beats the
+        # DAGRIDER_VERIFY_FALLBACK / DAGRIDER_VERIFY_RETRY env defaults.
+        from dag_rider_tpu.verifier.resilient import (
+            default_verify_fallback,
+            default_verify_retry,
+        )
+
+        fallback = cfg.get("verify_fallback")
+        fallback = (
+            default_verify_fallback() if fallback is None else str(fallback)
+        )
+        if fallback and fallback != "cpu":
+            raise ValueError(
+                f'verify_fallback must be "cpu" or empty, got {fallback!r}'
+            )
+        retry = cfg.get("verify_retry")
+        retry = default_verify_retry() if retry is None else int(retry)
+
+        def _ladder(primary):
+            from dag_rider_tpu.verifier.cpu import CPUVerifier
+            from dag_rider_tpu.verifier.resilient import ResilientVerifier
+
+            return ResilientVerifier(
+                [primary, CPUVerifier(reg)], retries=retry
+            )
+
         if kind in ("device", "sharded"):
             # Production entry-path parity with bench/tests: repo-local
             # XLA compile cache, then wrap the device verifier in a
@@ -229,6 +266,10 @@ class Node:
                 depth=int(depth) if depth else None,
                 warmup=bool(cfg.get("verify_warmup", True)),
             )
+            if fallback:
+                # ladder wiring also hands the pipeline's quarantined
+                # chunks to the CPU floor (quarantine_verifier)
+                verifier = _ladder(verifier)
         elif kind == "cpu":
             from dag_rider_tpu.verifier.cpu import CPUVerifier
 
@@ -245,8 +286,12 @@ class Node:
                     'verifier "remote" needs a "verifier_address"'
                 )
             verifier = RemoteVerifier(
-                addr, timeout=float(cfg.get("verifier_timeout_s", 30.0))
+                addr,
+                timeout=float(cfg.get("verifier_timeout_s", 30.0)),
+                retries=retry,
             )
+            if fallback:
+                verifier = _ladder(verifier)
         elif kind != "none":
             raise ValueError(f"unknown verifier {kind!r}")
 

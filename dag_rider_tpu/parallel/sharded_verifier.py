@@ -32,6 +32,14 @@ each dispatch rounds up to a multiple of the mesh batch axis, and padding
 rows are sliced off before any consumer sees them. So CPU / 1-chip /
 N-chip runs agree bit-for-bit at every pipeline depth (test_pipeline.py
 on the virtual 8-device CPU mesh).
+
+Round-9 fault containment inherits the same way: ``reset_staging`` /
+``_quarantine`` / the contained ``verify_rounds`` streaming loop and the
+``quarantine_verifier`` slot all live above the placement hooks, so a
+poisoned sharded window salvages, re-arms its (full-batch host) staging
+ring and quarantines exactly like single-chip — and the chaos harness
+(verifier/faults.py) arms this class through the identical instance-
+attribute shadows (tests/test_chaos.py runs its suite on both).
 """
 
 from __future__ import annotations

@@ -5,18 +5,29 @@ an arithmetic parameter (SURVEY.md §5: "required to claim BFT capability at
 all"). This wrapper layers Byzantine network behavior over any Transport:
 
 - drop: lose a message to some destination,
-- delay: hold a message back (re-queued on ``flush_delayed``),
+- delay: hold a message back (delivered on ``flush_delayed``),
 - duplicate: deliver twice,
 - equivocate: substitute a conflicting vertex for a chosen sender.
 
 All decisions come from a seeded RNG — runs are reproducible.
+
+Faults are applied at DELIVERY time, per (message, destination): subscribe
+captures each process's real handler and registers a wrapping handler with
+the inner transport, so the wrapper needs nothing from the inner beyond
+the two-method Transport interface — any broadcast/subscribe transport
+composes (round 9; before that the wrapper reached into
+InMemoryTransport.enqueue/subscribers and the "any Transport" claim was
+false). For the in-memory default the observable schedule is unchanged:
+FIFO delivery order equals enqueue order equals broadcast order, so the
+seeded roll sequence — and therefore every seed-pinned chaos test —
+is identical to the old broadcast-time injection.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dag_rider_tpu.core.types import BroadcastMessage, Vertex
 from dag_rider_tpu.transport.base import Handler, Transport
@@ -36,14 +47,19 @@ class FaultPlan:
 
 
 class FaultyTransport(Transport):
-    """Wraps an InMemoryTransport, applying a FaultPlan on broadcast."""
+    """Wraps any Transport (in-memory by default), applying a FaultPlan
+    to each delivery."""
 
-    def __init__(self, plan: FaultPlan, inner: Optional[InMemoryTransport] = None):
-        self.inner = inner if inner is not None else InMemoryTransport()
+    def __init__(self, plan: FaultPlan, inner: Optional[Transport] = None):
+        self.inner: Transport = (
+            inner if inner is not None else InMemoryTransport()
+        )
         self.plan = plan
         self.rng = random.Random(plan.seed)
+        #: (dest, real handler, message) held back by a delay roll
         self.delayed: List[tuple] = []
         self.stats = {"dropped": 0, "delayed": 0, "duplicated": 0, "equivocated": 0}
+        self._handlers: Dict[int, Handler] = {}
         self._mutator: Optional[Callable[[Vertex], Vertex]] = None
 
     def set_equivocation_mutator(self, fn: Callable[[Vertex], Vertex]) -> None:
@@ -51,32 +67,42 @@ class FaultyTransport(Transport):
         self._mutator = fn
 
     def subscribe(self, index: int, handler: Handler) -> None:
-        self.inner.subscribe(index, handler)
+        self._handlers[index] = handler
+
+        def wrapped(msg: BroadcastMessage) -> None:
+            self._deliver(index, handler, msg)
+
+        self.inner.subscribe(index, wrapped)
 
     def broadcast(self, msg: BroadcastMessage) -> None:
-        dests = [d for d in self.inner.subscribers() if d != msg.sender]
-        for dest in dests:
-            out = msg
-            if (
-                msg.kind == "val"
-                and msg.vertex is not None
-                and msg.sender in self.plan.equivocators
-                and self.rng.random() < 0.5
-            ):
-                out = dataclasses.replace(msg, vertex=self._equivocate(msg.vertex))
-                self.stats["equivocated"] += 1
-            roll = self.rng.random()
-            if roll < self.plan.drop:
-                self.stats["dropped"] += 1
-                continue
-            if roll < self.plan.drop + self.plan.delay:
-                self.stats["delayed"] += 1
-                self.delayed.append((dest, out))
-                continue
-            self._enqueue(dest, out)
-            if self.rng.random() < self.plan.duplicate:
-                self.stats["duplicated"] += 1
-                self._enqueue(dest, out)
+        self.inner.broadcast(msg)
+
+    def _deliver(self, dest: int, handler: Handler, msg: BroadcastMessage) -> None:
+        """One (message, destination) delivery through the plan. The
+        roll structure per delivery — optional equivocation coin, one
+        main drop/delay roll, a duplicate roll only when delivered — is
+        the original broadcast-time sequence verbatim."""
+        out = msg
+        if (
+            msg.kind == "val"
+            and msg.vertex is not None
+            and msg.sender in self.plan.equivocators
+            and self.rng.random() < 0.5
+        ):
+            out = dataclasses.replace(msg, vertex=self._equivocate(msg.vertex))
+            self.stats["equivocated"] += 1
+        roll = self.rng.random()
+        if roll < self.plan.drop:
+            self.stats["dropped"] += 1
+            return
+        if roll < self.plan.drop + self.plan.delay:
+            self.stats["delayed"] += 1
+            self.delayed.append((dest, handler, out))
+            return
+        handler(out)
+        if self.rng.random() < self.plan.duplicate:
+            self.stats["duplicated"] += 1
+            handler(out)
 
     def _equivocate(self, v: Vertex) -> Vertex:
         if self._mutator is not None:
@@ -87,25 +113,27 @@ class FaultyTransport(Transport):
             v, block=Block((b"equivocation-" + bytes(str(v.id), "ascii"),))
         )
 
-    def _enqueue(self, dest: int, msg: BroadcastMessage) -> None:
-        self.inner.enqueue(dest, msg)
-
     def flush_delayed(self) -> int:
-        """Release all held-back messages into the queue (asynchrony: every
-        message is eventually delivered)."""
-        n = len(self.delayed)
-        for dest, msg in self.delayed:
-            self._enqueue(dest, msg)
-        self.delayed.clear()
-        return n
+        """Deliver all held-back messages (asynchrony: every message is
+        eventually delivered). Straight to the captured real handlers —
+        a delayed message already paid its fault rolls and must not roll
+        again on the way out."""
+        held, self.delayed = self.delayed, []
+        for _dest, handler, msg in held:
+            handler(msg)
+        return len(held)
 
-    # pump passthrough so Simulation can drive us
+    # pump passthrough so Simulation can drive us; inners without a pump
+    # loop (push-style transports deliver inside broadcast) have nothing
+    # to drive and nothing pending
     def pump_one(self) -> bool:
-        return self.inner.pump_one()
+        fn = getattr(self.inner, "pump_one", None)
+        return bool(fn()) if callable(fn) else False
 
     def pump(self, max_messages: Optional[int] = None) -> int:
-        return self.inner.pump(max_messages)
+        fn = getattr(self.inner, "pump", None)
+        return int(fn(max_messages)) if callable(fn) else 0
 
     @property
     def pending(self) -> int:
-        return self.inner.pending
+        return int(getattr(self.inner, "pending", 0))

@@ -57,6 +57,18 @@ class Metrics:
         #: of prepped rows that took the parallel row-block path
         self.verify_prep_workers = 0
         self.verify_prep_parallel_fraction: float | None = None
+        #: round-9 resilience gauges (verifier/resilient.py + the
+        #: containment seams): absolute counters mirrored from the
+        #: shared verify stack, None until a resilient run reported
+        self.verify_retries: int | None = None
+        self.verify_fallback_tier: int | None = None
+        self.verify_quarantined: int | None = None
+        self.sidecar_rpc_failures: int | None = None
+        #: 1 = the sidecar tier answered its last probe, 0 = down,
+        #: None = no sidecar tier in the stack
+        self.sidecar_health: int | None = None
+        #: transport chaos counters (FaultyTransport.stats), absolute
+        self.transport_faults: Dict[str, int] | None = None
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -110,6 +122,35 @@ class Metrics:
         below the block floor or the engine never engaged)."""
         self.verify_prep_workers = int(workers)
         self.verify_prep_parallel_fraction = float(parallel_fraction)
+
+    def observe_resilience(
+        self,
+        retries: int,
+        fallback_tier: int,
+        quarantined: int,
+        sidecar_health: int | None = None,
+        rpc_failures: int = 0,
+    ) -> None:
+        """Latest resilience gauges of the shared verify stack
+        (ResilientVerifier.resilience_stats / the pipeline's containment
+        counters): cumulative retry count, the tier index that answered
+        the most recent call (0 = preferred tier, len(tiers) = whole
+        ladder exhausted), chunks re-verified in quarantine, sidecar
+        probe health, and transport-level sidecar RPC failures — the
+        counter that distinguishes a dead sidecar from a batch of
+        invalid signatures (both read all-False at mask level)."""
+        self.verify_retries = int(retries)
+        self.verify_fallback_tier = int(fallback_tier)
+        self.verify_quarantined = int(quarantined)
+        self.sidecar_rpc_failures = int(rpc_failures)
+        if sidecar_health is not None:
+            self.sidecar_health = int(sidecar_health)
+
+    def observe_transport_faults(self, stats: Dict[str, int]) -> None:
+        """Absolute FaultyTransport.stats counters
+        (dropped/delayed/duplicated/equivocated) — chaos runs surface
+        their injected network faults next to the verifier gauges."""
+        self.transport_faults = dict(stats)
 
     def mark_verify_amortized(self) -> None:
         """Flag this process's verify timings as AMORTIZED: under the
@@ -180,6 +221,16 @@ class Metrics:
             out["verify_prep_parallel_fraction"] = round(
                 self.verify_prep_parallel_fraction or 0.0, 4
             )
+        if self.verify_retries is not None:
+            out["verify_retries"] = self.verify_retries
+            out["verify_fallback_tier"] = self.verify_fallback_tier or 0
+            out["verify_quarantined"] = self.verify_quarantined or 0
+            out["sidecar_rpc_failures"] = self.sidecar_rpc_failures or 0
+        if self.sidecar_health is not None:
+            out["sidecar_health"] = self.sidecar_health
+        if self.transport_faults is not None:
+            for k, v in self.transport_faults.items():
+                out[f"transport_{k}"] = v
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)
         if self.wave_interval_seconds:

@@ -1,6 +1,16 @@
-from dag_rider_tpu.verifier.base import KeyRegistry, Verifier, VertexSigner
+from dag_rider_tpu.verifier.base import (
+    KeyRegistry,
+    Verifier,
+    VerifierUnavailableError,
+    VertexSigner,
+)
 from dag_rider_tpu.verifier.cpu import CPUVerifier, NullVerifier
+from dag_rider_tpu.verifier.faults import (
+    VerifierFaultInjector,
+    VerifierFaultPlan,
+)
 from dag_rider_tpu.verifier.pipeline import VerifierPipeline
+from dag_rider_tpu.verifier.resilient import ResilientVerifier
 
 __all__ = [
     "KeyRegistry",
@@ -9,4 +19,8 @@ __all__ = [
     "CPUVerifier",
     "NullVerifier",
     "VerifierPipeline",
+    "ResilientVerifier",
+    "VerifierUnavailableError",
+    "VerifierFaultInjector",
+    "VerifierFaultPlan",
 ]

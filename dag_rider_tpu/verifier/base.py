@@ -79,6 +79,16 @@ class VertexSigner:
         return dataclasses.replace(v, signature=sig)
 
 
+class VerifierUnavailableError(RuntimeError):
+    """A verifier backend could not be reached or could not complete an
+    attempt (transport failure, dead sidecar, poisoned device state) — as
+    opposed to a *verdict*: no statement about signature validity is
+    implied. Backends raise it (when configured to) so a degradation
+    ladder (verifier/resilient.py) can distinguish "try the next tier"
+    from "these signatures are invalid"; without a ladder the same
+    condition fail-closes to an all-False mask."""
+
+
 class Verifier(abc.ABC):
     """Batched vertex-signature verification."""
 

@@ -97,7 +97,21 @@ class VerifierPipeline(Verifier):
             verifier.pipeline_depth = self.depth
         if fixed_bucket is not None:
             verifier.fixed_bucket = fixed_bucket
+        #: (pending handle, chunk) FIFO — the chunk rides along so a
+        #: dispatch/resolve fault can quarantine exactly the vertices it
+        #: poisoned (round-9 containment)
         self._inflight: Deque[tuple] = deque()
+        #: masks already produced by fault containment, FIFO-ordered
+        #: ahead of everything in _inflight; _resolve_oldest consumes
+        #: these first so the concatenated mask keeps chunk order
+        self._salvaged: Deque[List[bool]] = deque()
+        #: next tier for quarantined chunks (wired by ResilientVerifier);
+        #: None = one serial retry on the wrapped verifier, then reject
+        self.quarantine_verifier: Optional[Verifier] = None
+        #: fault-containment gauges (round 9)
+        self.poisoned_windows = 0
+        self.quarantined = 0
+        self.quarantine_rejected = 0
         #: cumulative window accounting (the bench's amortization gauges)
         self.dispatches = 0
         self.sigs_dispatched = 0
@@ -129,14 +143,24 @@ class VerifierPipeline(Verifier):
     # -- window mechanics ------------------------------------------------
 
     def _dispatch(self, chunk: Sequence[Vertex]) -> None:
-        self._inflight.append(self.verifier.dispatch_batch(chunk))
+        try:
+            handle = self.verifier.dispatch_batch(chunk)
+        except Exception:  # noqa: BLE001 — prep/dispatch fault contained
+            self._contain(chunk, failed_first=False)
+            return
+        self._inflight.append((handle, chunk))
         self._book_dispatch(len(chunk))
 
-    def _dispatch_prepped(self, prepped) -> None:
+    def _dispatch_prepped(self, prepped, chunk: Sequence[Vertex]) -> None:
         """Ship a batch already prepped on the engine's seam thread
         (TPUVerifier.prep_batch_async) — same window accounting as
         _dispatch, prep already paid."""
-        self._inflight.append(self.verifier.dispatch_prepped(prepped))
+        try:
+            handle = self.verifier.dispatch_prepped(prepped)
+        except Exception:  # noqa: BLE001 — dispatch fault contained
+            self._contain(chunk, failed_first=False)
+            return
+        self._inflight.append((handle, chunk))
         self._book_dispatch(prepped.count)
 
     def _book_dispatch(self, count: int) -> None:
@@ -148,9 +172,23 @@ class VerifierPipeline(Verifier):
         if d > self.last_max_depth:
             self.last_max_depth = d
 
+    def _pending(self) -> int:
+        """Masks still owed to the caller: contained (already computed)
+        plus in flight on the device."""
+        return len(self._salvaged) + len(self._inflight)
+
     def _resolve_oldest(self) -> List[bool]:
+        if self._salvaged:
+            # containment already produced this chunk's mask; it is
+            # older than anything in _inflight by construction
+            return self._salvaged.popleft()
+        handle, chunk = self._inflight.popleft()
         t0 = time.perf_counter()
-        out = self.verifier.resolve_batch(self._inflight.popleft())
+        try:
+            out = self.verifier.resolve_batch(handle)
+        except Exception:  # noqa: BLE001 — resolve fault contained
+            self._contain(chunk, failed_first=True)
+            out = self._salvaged.popleft()
         dt = time.perf_counter() - t0
         self.wait_s += dt
         self.last_wait_s += dt
@@ -158,6 +196,63 @@ class VerifierPipeline(Verifier):
         # own sync verify_batch books the same quantity for itself)
         if hasattr(self.verifier, "total_dispatch_s"):
             self.verifier.total_dispatch_s += dt
+        return out
+
+    # -- fault containment (round 9) --------------------------------------
+
+    def _quarantine(self, chunk: Sequence[Vertex]) -> List[bool]:
+        """Re-verify a chunk out of a poisoned window exactly once: on
+        the ladder's next tier when ResilientVerifier wired one, else a
+        fresh serial pass on the wrapped verifier. A second failure
+        rejects the chunk — fail closed, never fail open."""
+        self.quarantined += 1
+        vs = list(chunk)
+        try:
+            if self.quarantine_verifier is not None:
+                return self.quarantine_verifier.verify_batch(vs)
+            return self.verifier.verify_batch(vs)
+        except Exception:  # noqa: BLE001 — second failure fail-closes
+            self.quarantine_rejected += 1
+            return [False] * len(vs)
+
+    def _contain(self, chunk: Sequence[Vertex], failed_first: bool) -> None:
+        """A dispatch or resolve exception poisoned the window: resolve
+        every salvageable in-flight entry (a second fault quarantines
+        that chunk too), re-arm the staging ring (fresh slots — the
+        aliasing discipline survives orphaned dispatches, see
+        TPUVerifier.reset_staging), then quarantine the failing chunk.
+        The resulting masks land on ``_salvaged`` in FIFO chunk order:
+        ``failed_first`` is True for a resolve fault (the failed chunk
+        was the oldest, already popped) and False for a dispatch fault
+        (the failed chunk never entered the window)."""
+        self.poisoned_windows += 1
+        entries = []  # (mask-or-None, chunk) in FIFO order
+        while self._inflight:
+            h, ch = self._inflight.popleft()
+            try:
+                entries.append((self.verifier.resolve_batch(h), ch))
+            except Exception:  # noqa: BLE001 — quarantined after reset
+                entries.append((None, ch))
+        if callable(getattr(self.verifier, "reset_staging", None)):
+            self.verifier.reset_staging()
+        masks: List[List[bool]] = []
+        if failed_first:
+            masks.append(self._quarantine(chunk))
+        for m, ch in entries:
+            masks.append(m if m is not None else self._quarantine(ch))
+        if not failed_first:
+            masks.append(self._quarantine(chunk))
+        self._salvaged.extend(masks)
+
+    def drain(self) -> List[bool]:
+        """Resolve everything still owed — salvaged containment masks
+        plus the in-flight window — and return the concatenated mask.
+        The reset seam for callers recovering from an external failure:
+        after drain() the window is empty and the next dispatch starts
+        clean."""
+        out: List[bool] = []
+        while self._pending():
+            out.extend(self._resolve_oldest())
         return out
 
     def run_coalesced(
@@ -208,19 +303,31 @@ class VerifierPipeline(Verifier):
             preps: Deque = deque()
             nxt = 0
             while nxt < len(chunks) and len(preps) < 2:
-                preps.append(self.verifier.prep_batch_async(chunks[nxt]))
+                preps.append(
+                    (self.verifier.prep_batch_async(chunks[nxt]), chunks[nxt])
+                )
                 nxt += 1
             while preps:
-                prepped = preps.popleft().result()
-                while len(self._inflight) >= depth:
-                    mask.extend(self._resolve_oldest())
-                self._dispatch_prepped(prepped)
+                fut, chunk = preps.popleft()
+                try:
+                    prepped = fut.result()
+                except Exception:  # noqa: BLE001 — prep fault contained
+                    self._contain(chunk, failed_first=False)
+                else:
+                    while self._pending() >= depth:
+                        mask.extend(self._resolve_oldest())
+                    self._dispatch_prepped(prepped, chunk)
                 if nxt < len(chunks):
-                    preps.append(self.verifier.prep_batch_async(chunks[nxt]))
+                    preps.append(
+                        (
+                            self.verifier.prep_batch_async(chunks[nxt]),
+                            chunks[nxt],
+                        )
+                    )
                     nxt += 1
         else:
             for chunk in chunks:
-                while len(self._inflight) >= depth:
+                while self._pending() >= depth:
                     mask.extend(self._resolve_oldest())
                 self._dispatch(chunk)
         overlap_s = 0.0
@@ -228,7 +335,7 @@ class VerifierPipeline(Verifier):
             t1 = time.perf_counter()
             overlap()
             overlap_s = time.perf_counter() - t1
-        while self._inflight:
+        while self._pending():
             mask.extend(self._resolve_oldest())
         self.last_seam_s = max(0.0, (time.perf_counter() - t0) - overlap_s)
         self.seam_s += self.last_seam_s
@@ -297,4 +404,30 @@ class VerifierPipeline(Verifier):
             out["shard_imbalance"] = round(
                 getattr(self.verifier, "last_shard_imbalance", 0.0), 3
             )
+        # fault-containment gauges (round 9), only once something was
+        # actually contained — the clean path's stats dict is unchanged
+        rs = self.resilience_stats()
+        if rs["poisoned_windows"] or rs["quarantined"]:
+            out["poisoned_windows"] = rs["poisoned_windows"]
+            out["quarantined"] = rs["quarantined"]
+            out["quarantine_rejected"] = rs["quarantine_rejected"]
         return out
+
+    def resilience_stats(self) -> dict:
+        """Round-9 containment gauges, pipeline window + wrapped
+        verifier's own chunk-streaming path combined. Same key shape as
+        ResilientVerifier.resilience_stats so consumers (Simulation's
+        metrics fan-out, the bench's verifier_breakdown) read either."""
+        return {
+            "retries": getattr(self.verifier, "retries_total", 0),
+            "fallback_tier": 0,
+            "fallbacks": 0,
+            "poisoned_windows": self.poisoned_windows
+            + getattr(self.verifier, "poisoned_windows", 0),
+            "quarantined": self.quarantined
+            + getattr(self.verifier, "quarantined_chunks", 0),
+            "quarantine_rejected": self.quarantine_rejected
+            + getattr(self.verifier, "quarantine_rejected", 0),
+            "sidecar_rpc_failures": getattr(self.verifier, "rpc_failures", 0),
+            "sidecar_health": None,
+        }

@@ -102,6 +102,7 @@ class PrepEngine:
         self.dispatches_parallel = 0
         self.rows_total = 0
         self.rows_parallel = 0
+        self.serial_retries = 0
 
     # -- row-block half ---------------------------------------------------
 
@@ -127,8 +128,16 @@ class PrepEngine:
     ) -> None:
         """Run ``fn(lo, hi)`` over every block; the calling thread takes
         the first block, the pool the rest. Blocks until all blocks are
-        done; the first worker exception propagates (the staging slot is
-        then considered unwritten and the dispatch must not ship)."""
+        done.
+
+        A PARALLEL failure is contained at this boundary (round 9): all
+        outstanding blocks are waited out (never retried concurrently —
+        they share the destination arrays), then the whole range is
+        re-run serially ONCE. `_prep_block` fully overwrites its rows,
+        so the serial pass is byte-identical no matter which blocks had
+        partially written. Only if the serial pass also fails does the
+        exception surface — the staging slot is then considered
+        unwritten and the dispatch must not ship."""
         self.dispatches += 1
         size = blocks[-1][1]
         self.rows_total += size
@@ -139,9 +148,19 @@ class PrepEngine:
         self.dispatches_parallel += 1
         self.rows_parallel += size
         futs = [self._pool.submit(fn, lo, hi) for lo, hi in blocks[1:]]
-        fn(*blocks[0])
+        failed = False
+        try:
+            fn(*blocks[0])
+        except Exception:  # noqa: BLE001 — retried serially below
+            failed = True
         for f in futs:
-            f.result()
+            try:
+                f.result()
+            except Exception:  # noqa: BLE001 — retried serially below
+                failed = True
+        if failed:
+            self.serial_retries += 1
+            fn(0, size)
 
     # -- pipeline-seam half ----------------------------------------------
 

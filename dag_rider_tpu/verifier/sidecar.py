@@ -19,14 +19,16 @@ TPU, and remote-TPU verifier placements.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import grpc
 
 from dag_rider_tpu.core import codec
 from dag_rider_tpu.core.types import Vertex
-from dag_rider_tpu.verifier.base import Verifier
+from dag_rider_tpu.verifier.base import Verifier, VerifierUnavailableError
 
 _METHOD = "/dagrider.Verifier/VerifyBatch"
 _identity = lambda b: b  # noqa: E731
@@ -121,33 +123,121 @@ class VerifierSidecarServer:
 class RemoteVerifier(Verifier):
     """Verifier seam implementation that defers to a sidecar.
 
-    Fail-closed: transport errors reject the whole batch (a vertex whose
-    signature cannot be checked must not enter the DAG — SURVEY.md D10's
-    fix requires signatures before any state change).
+    Fail-closed **per attempt** (SURVEY.md D10: signatures before any
+    state change): a transport failure — RPC error, deadline, or a
+    malformed/mis-sized reply — must never admit a vertex. What happens
+    after a failed attempt is configurable:
+
+    - ``retries`` > 0 re-sends the same payload with exponential backoff
+      plus seeded jitter, reconnecting the channel between attempts (a
+      restarted sidecar gets a fresh connection instead of a wedged one);
+    - once every attempt has failed, the default is the pre-round-9
+      contract — the whole batch reads ``[False] * n``, indistinguishable
+      from n invalid signatures at the mask level (the
+      ``sidecar_rpc_failures`` counter is what tells the two apart in
+      metrics);
+    - with ``raise_on_unavailable=True`` exhaustion raises
+      :class:`VerifierUnavailableError` instead, so a degradation ladder
+      (verifier/resilient.py) can hand the batch to its next tier rather
+      than permanently rejecting valid vertices on a sidecar blip.
+
+    Either way no attempt ever accepts a vertex it could not check.
     """
 
-    def __init__(self, address: str, *, timeout: float = 30.0):
-        self._channel = grpc.insecure_channel(address)
+    def __init__(
+        self,
+        address: str,
+        *,
+        timeout: float = 30.0,
+        retries: int = 0,
+        backoff_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        jitter: float = 0.5,
+        seed: int = 0,
+        raise_on_unavailable: bool = False,
+    ):
+        self._address = address
+        self._timeout = timeout
+        self._retries = max(0, int(retries))
+        self._backoff_s = float(backoff_s)
+        self._backoff_cap_s = float(backoff_cap_s)
+        self._jitter = float(jitter)
+        self._rng = random.Random(seed)
+        self.raise_on_unavailable = raise_on_unavailable
+        self._lock = threading.Lock()
+        #: transport-level failures (RPC error/timeout/bad reply) — NOT
+        #: invalid signatures; surfaced as metrics counter
+        #: ``sidecar_rpc_failures`` so chaos runs can tell a dead sidecar
+        #: from a batch of forgeries (both read all-False at mask level)
+        self.rpc_failures = 0
+        #: re-sends of a payload after a failed attempt
+        self.retries_total = 0
+        self._connect()
+
+    def _connect(self) -> None:
+        self._channel = grpc.insecure_channel(self._address)
         self._call = self._channel.unary_unary(
             _METHOD,
             request_serializer=_identity,
             response_deserializer=_identity,
         )
-        self._timeout = timeout
-        self._lock = threading.Lock()
+
+    def reconnect(self) -> None:
+        """Tear down and rebuild the channel — between retry attempts and
+        when a health probe wants a fresh connection to a restarted
+        sidecar (gRPC keeps a failed subchannel in backoff otherwise)."""
+        with self._lock:
+            self._channel.close()
+            self._connect()
+
+    def _invoke(self, payload: bytes) -> bytes:
+        """One locked RPC attempt — the seam the chaos harness
+        (verifier/faults.py) shadows to inject sidecar failures."""
+        with self._lock:
+            return self._call(payload, timeout=self._timeout)
+
+    def ping(self) -> bool:
+        """Health probe: round-trip an EMPTY batch (encodes to b"", the
+        backend verifies nothing and answers b""). True iff the sidecar
+        answered — used by the degradation ladder to promote this tier
+        back after recovery. Never counts toward rpc_failures."""
+        try:
+            return self._invoke(b"") == b""
+        except (grpc.RpcError, VerifierUnavailableError):
+            return False
+
+    def stats(self) -> dict:
+        return {
+            "sidecar_rpc_failures": self.rpc_failures,
+            "retries": self.retries_total,
+        }
 
     def verify_batch(self, vertices: Sequence[Vertex]) -> List[bool]:
         if not vertices:
             return []
         payload = _encode_batch(vertices)
-        try:
-            with self._lock:
-                mask = self._call(payload, timeout=self._timeout)
-        except grpc.RpcError:
-            return [False] * len(vertices)
-        if len(mask) != len(vertices):
-            return [False] * len(vertices)
-        return [b == 1 for b in mask]
+        delay = self._backoff_s
+        for attempt in range(self._retries + 1):
+            try:
+                mask = self._invoke(payload)
+            except (grpc.RpcError, VerifierUnavailableError):
+                self.rpc_failures += 1
+            else:
+                if len(mask) == len(vertices):
+                    return [b == 1 for b in mask]
+                # a mis-sized reply is a transport fault, not a verdict
+                self.rpc_failures += 1
+            if attempt < self._retries:
+                self.retries_total += 1
+                time.sleep(delay * (1.0 + self._jitter * self._rng.random()))
+                delay = min(delay * 2.0, self._backoff_cap_s)
+                self.reconnect()
+        if self.raise_on_unavailable:
+            raise VerifierUnavailableError(
+                f"sidecar {self._address} unavailable after "
+                f"{self._retries + 1} attempt(s)"
+            )
+        return [False] * len(vertices)
 
     def close(self) -> None:
         self._channel.close()

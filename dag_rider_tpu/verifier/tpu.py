@@ -572,6 +572,17 @@ class TPUVerifier(Verifier):
         self._staging_idx[size] = (i + 1) % len(ring)
         return ring[i]
 
+    def reset_staging(self) -> None:
+        """Re-arm the staging ring after a poisoned window (round-9
+        containment seam). The cursor no longer matches the in-flight
+        count once a dispatch or resolve has failed, so the only way to
+        keep the aliasing discipline is FRESH slots: the old ring list
+        is dropped, not rewritten — any orphan dispatch still executing
+        keeps its zero-copy views of the old arrays alive, and the next
+        _stage() builds a new ring that cannot alias them."""
+        self._staging.clear()
+        self._staging_idx.clear()
+
     # -- dispatch seam hooks ---------------------------------------------
     # dispatch_batch/warmup route every placement-sensitive decision
     # through these overridables, so ShardedTPUVerifier (parallel/
@@ -696,6 +707,21 @@ class TPUVerifier(Verifier):
     #: let verify_batch reach past it).
     pipeline_enabled: bool = True
 
+    #: Next-tier verifier for chunks quarantined out of a poisoned
+    #: window. Wired by ResilientVerifier (verifier/resilient.py) so a
+    #: chunk whose dispatch/resolve failed is re-verified once on the
+    #: ladder's next tier; None = one serial retry on this verifier,
+    #: then fail closed for that chunk.
+    quarantine_verifier: Optional[Verifier] = None
+
+    #: Fault-containment gauges (round 9): windows poisoned by a
+    #: dispatch/resolve/prep exception, chunks re-verified in
+    #: quarantine, and quarantine retries that failed too (those chunks
+    #: read all-False — fail closed).
+    poisoned_windows: int = 0
+    quarantined_chunks: int = 0
+    quarantine_rejected: int = 0
+
     #: Requested worker count for the parallel host-prep engine
     #: (verifier/prep.py). None defers to DAGRIDER_PREP_WORKERS (default
     #: 1 = serial). Assigning a new value rebuilds the engine on the
@@ -731,6 +757,7 @@ class TPUVerifier(Verifier):
             "parallel_fraction": eng.parallel_fraction(),
             "rows_total": eng.rows_total,
             "rows_parallel": eng.rows_parallel,
+            "serial_retries": eng.serial_retries,
         }
 
     def prep_batch(self, vertices: Sequence[Vertex]) -> "PreppedBatch":
@@ -802,6 +829,62 @@ class TPUVerifier(Verifier):
                 mask = self._windowed_dispatch(args)
         return mask, count
 
+    # -- fault containment (round 9) --------------------------------------
+
+    def _quarantine(self, vertices: Sequence[Vertex]) -> List[bool]:
+        """Re-verify a chunk out of a poisoned window exactly once: on
+        the ladder's next tier when one is wired (quarantine_verifier),
+        else a fresh serial dispatch on this verifier. A second failure
+        rejects the chunk — fail closed, never fail open."""
+        self.quarantined_chunks += 1
+        vs = list(vertices)
+        try:
+            if self.quarantine_verifier is not None:
+                return self.quarantine_verifier.verify_batch(vs)
+            return self._resolve_timed(self.dispatch_batch(vs))
+        except Exception:  # noqa: BLE001 — second failure fail-closes
+            self.quarantine_rejected += 1
+            return [False] * len(vs)
+
+    def _contain_stream(
+        self, inflight, chunk: Sequence[Vertex], failed_first: bool
+    ) -> List[bool]:
+        """Contain a fault in the chunk-streaming window: salvage every
+        in-flight entry (resolve it; a second fault quarantines that
+        chunk too), re-arm the staging ring, then quarantine the failing
+        chunk. Returns the masks in FIFO chunk order — ``failed_first``
+        is True for a resolve fault (the failed chunk was the oldest,
+        already popped) and False for a prep/dispatch fault (the failed
+        chunk never entered the window, so salvaged chunks come first).
+        """
+        self.poisoned_windows += 1
+        salvaged = []  # (mask-or-None, chunk) in FIFO order
+        while inflight:
+            h, ch = inflight.popleft()
+            try:
+                salvaged.append((self._resolve_timed(h), ch))
+            except Exception:  # noqa: BLE001 — quarantined after reset
+                salvaged.append((None, ch))
+        self.reset_staging()
+        out: List[bool] = []
+        if failed_first:
+            out.extend(self._quarantine(chunk))
+        for m, ch in salvaged:
+            out.extend(m if m is not None else self._quarantine(ch))
+        if not failed_first:
+            out.extend(self._quarantine(chunk))
+        return out
+
+    def _resolve_stream(self, inflight) -> List[bool]:
+        """Resolve the oldest in-flight chunk, containing a resolve
+        fault (the rest of the window is salvaged, the ring re-armed,
+        and the failing chunk quarantined)."""
+        h, ch = inflight.popleft()
+        try:
+            return self._resolve_timed(h)
+        except Exception:  # noqa: BLE001 — contained, not propagated
+            return self._contain_stream(inflight, ch, failed_first=True)
+
     def dispatch_batch(self, vertices: Sequence[Vertex]):
         """Asynchronous half of verify: host prep + device dispatch, NO
         sync. Returns an opaque (device_mask, count) pending handle for
@@ -833,6 +916,11 @@ class TPUVerifier(Verifier):
         (prep_batch_async) — chunk k+2's prep overlaps chunk k+1's prep
         and chunk k's execution. Chunk boundaries and FIFO resolve order
         are unchanged, so the mask stays byte-identical.
+
+        A prep/dispatch/resolve exception is CONTAINED, not propagated
+        (round 9): the window is salvaged, the staging ring re-armed,
+        and the failing chunk quarantined (_contain_stream) — the merge
+        always returns a full mask, wedging nothing upstream.
         """
         lens = [len(r) for r in rounds]
         flat = [v for r in rounds for v in r]
@@ -844,7 +932,7 @@ class TPUVerifier(Verifier):
 
             depth = self.pipeline_depth if self.pipeline_enabled else 1
             chunks = [flat[i : i + cap] for i in range(0, len(flat), cap)]
-            inflight: deque = deque()
+            inflight: deque = deque()  # (pending handle, chunk) FIFO
             mask = []
             if depth > 1 and len(chunks) > 1:
                 # Prep-ahead ordering discipline: at most 2 prep futures
@@ -856,23 +944,53 @@ class TPUVerifier(Verifier):
                 preps: deque = deque()
                 nxt = 0
                 while nxt < len(chunks) and len(preps) < 2:
-                    preps.append(self.prep_batch_async(chunks[nxt]))
+                    preps.append(
+                        (self.prep_batch_async(chunks[nxt]), chunks[nxt])
+                    )
                     nxt += 1
                 while preps:
-                    prepped = preps.popleft().result()
-                    while len(inflight) >= depth:
-                        mask.extend(self._resolve_timed(inflight.popleft()))
-                    inflight.append(self.dispatch_prepped(prepped))
+                    fut, chunk = preps.popleft()
+                    try:
+                        prepped = fut.result()
+                    except Exception:  # noqa: BLE001 — prep fault
+                        mask.extend(
+                            self._contain_stream(
+                                inflight, chunk, failed_first=False
+                            )
+                        )
+                        prepped = None
+                    if prepped is not None:
+                        while len(inflight) >= depth:
+                            mask.extend(self._resolve_stream(inflight))
+                        try:
+                            inflight.append(
+                                (self.dispatch_prepped(prepped), chunk)
+                            )
+                        except Exception:  # noqa: BLE001 — dispatch fault
+                            mask.extend(
+                                self._contain_stream(
+                                    inflight, chunk, failed_first=False
+                                )
+                            )
                     if nxt < len(chunks):
-                        preps.append(self.prep_batch_async(chunks[nxt]))
+                        preps.append(
+                            (self.prep_batch_async(chunks[nxt]), chunks[nxt])
+                        )
                         nxt += 1
             else:
                 for chunk in chunks:
                     while len(inflight) >= depth:
-                        mask.extend(self._resolve_timed(inflight.popleft()))
-                    inflight.append(self.dispatch_batch(chunk))
+                        mask.extend(self._resolve_stream(inflight))
+                    try:
+                        inflight.append((self.dispatch_batch(chunk), chunk))
+                    except Exception:  # noqa: BLE001 — prep/dispatch fault
+                        mask.extend(
+                            self._contain_stream(
+                                inflight, chunk, failed_first=False
+                            )
+                        )
             while inflight:
-                mask.extend(self._resolve_timed(inflight.popleft()))
+                mask.extend(self._resolve_stream(inflight))
         else:
             mask = self.verify_batch(flat)
         out, pos = [], 0

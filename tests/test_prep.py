@@ -78,16 +78,45 @@ def test_run_blocks_writes_every_row_and_counts():
         eng.close()
 
 
-def test_run_blocks_propagates_worker_exception():
+def test_run_blocks_propagates_persistent_exception():
+    """A fault that survives the serial retry still propagates — the
+    block-pool boundary contains transient worker faults, it does not
+    invent masks for batches that cannot be prepped."""
     eng = PrepEngine(4)
     try:
 
         def boom(lo, hi):
-            if lo > 0:
-                raise RuntimeError("worker failed")
+            raise RuntimeError("worker failed")
 
         with pytest.raises(RuntimeError, match="worker failed"):
             eng.run_blocks(boom, eng.plan(64))
+        assert eng.serial_retries == 1
+    finally:
+        eng.close()
+
+
+def test_run_blocks_serial_retry_recovers_transient_fault():
+    """A parallel-only fault (raises for worker blocks, lo > 0) is
+    caught at the block-pool boundary and the whole range re-runs
+    serially; the result is byte-complete because _prep_block-style
+    fns fully overwrite their rows."""
+    eng = PrepEngine(4)
+    try:
+        out = np.zeros(64, dtype=np.int64)
+        retry_calls = []
+
+        def flaky(lo, hi):
+            if lo > 0:
+                raise RuntimeError("transient worker fault")
+            if (lo, hi) == (0, 64):
+                retry_calls.append((lo, hi))
+            out[lo:hi] = np.arange(lo, hi)
+
+        eng.run_blocks(flaky, eng.plan(64))
+        assert np.array_equal(out, np.arange(64))
+        assert eng.serial_retries == 1
+        # the retry was exactly one serial full-range pass
+        assert retry_calls == [(0, 64)]
     finally:
         eng.close()
 
