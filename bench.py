@@ -944,6 +944,151 @@ def _measure() -> None:
     if hostsim256_s > 0 and left() > hostsim256_s + 10:
         host_rung(256, hostsim256_s)
 
+    # -- ladder rung #9 (round 10): mempool-fronted end-to-end commit
+    # pipeline — client transactions through admission/batching/consensus
+    # to a_deliver on the WALL clock, so committed-tx/s and the
+    # submit→a_deliver percentiles are what a cluster client would see.
+    # Null verifier on purpose: the crypto seam has its own rungs; this
+    # one prices the ingestion + ordering pipeline. The chaos variant
+    # reruns a tight pool under phase-aligned bursts THROUGH an
+    # unreliable transport (delay + duplicate faults) on the virtual
+    # clock — the acceptance gate is shed-not-crash: audit lost == 0
+    # and duplicates == 0 WITH shed > 0, agreement intact.
+    mp_secs = float(os.environ.get("DAGRIDER_BENCH_MEMPOOL_S", "20"))
+    mp_n = int(os.environ.get("DAGRIDER_BENCH_MEMPOOL_N", "256"))
+    mp_rate = float(os.environ.get("DAGRIDER_BENCH_MEMPOOL_RATE", "4000"))
+    # the drain (commit the tail of in-flight blocks) is wall-bounded
+    # separately: ~16 DAG rounds at n=256 is minutes of host pumping on
+    # a slow core, and the rung must never eat the remaining ladder
+    mp_drain = float(os.environ.get("DAGRIDER_BENCH_MEMPOOL_DRAIN_S", "30"))
+    if mp_secs > 0 and left() > mp_secs + mp_drain + 20:
+        from dag_rider_tpu.config import Config as _MpCfg
+        from dag_rider_tpu.config import MempoolConfig as _MpMCfg
+        from dag_rider_tpu.consensus.simulator import Simulation as _MpSim
+        from dag_rider_tpu.mempool.loadgen import (
+            ClusterLoadDriver,
+            LoadGenerator,
+        )
+
+        _mark(
+            f"ladder mempool_e2e: n={mp_n}, {mp_rate:,.0f} tx/s offered, "
+            f"{mp_secs:.0f}s wall"
+        )
+        try:
+            sim = _MpSim(
+                _MpCfg(
+                    n=mp_n,
+                    coin="round_robin",
+                    propose_empty=True,
+                    gc_depth=24,
+                    sync_patience=0,  # see ClusterLoadDriver docstring
+                )
+            )
+            gen = LoadGenerator(
+                clients=32,
+                rate=mp_rate,
+                tx_bytes=32,
+                seed=10,
+                profile="poisson",
+            )
+            drv = ClusterLoadDriver(
+                sim,
+                gen,
+                mcfg=_MpMCfg(cap=65536, batch_bytes=4096),
+                wall=True,
+            )
+            entry = drv.run(mp_secs, drain_s=mp_drain)
+            sim.check_agreement()
+            entry["verifier"] = "none"
+            entry["agreement"] = True
+            result["ladder"]["mempool_e2e"] = entry
+            if entry["audit"]["lost"] or entry["audit"]["duplicates"]:
+                raise AssertionError(f"mempool audit failed: {entry['audit']}")
+            _mark(
+                f"ladder mempool_e2e: {entry['committed_tx_per_sec']:,.0f} "
+                f"committed tx/s ({entry['committed_tx']} committed / "
+                f"{entry['offered_tx']} offered), fill "
+                f"{entry['batch_fill']}, p50 "
+                f"{entry.get('submit_deliver_p50_ms')} ms / p99 "
+                f"{entry.get('submit_deliver_p99_ms')} ms"
+            )
+            emit()
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder mempool_e2e FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder mempool_e2e (left {left():.0f}s)")
+
+    mpc_secs = float(os.environ.get("DAGRIDER_BENCH_MEMPOOL_CHAOS_S", "1"))
+    mpc_n = int(os.environ.get("DAGRIDER_BENCH_MEMPOOL_CHAOS_N", "64"))
+    if mpc_secs > 0 and left() > 50:
+        from dag_rider_tpu.config import Config as _MpCfg
+        from dag_rider_tpu.config import MempoolConfig as _MpMCfg
+        from dag_rider_tpu.consensus.simulator import Simulation as _MpSim
+        from dag_rider_tpu.mempool.loadgen import (
+            ClusterLoadDriver,
+            LoadGenerator,
+        )
+        from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
+
+        _mark(
+            f"ladder mempool_chaos: n={mpc_n}, 8x bursts over tight pool, "
+            f"delay/duplicate faults, {mpc_secs:.0f}s virtual"
+        )
+        try:
+            sim = _MpSim(
+                _MpCfg(
+                    n=mpc_n,
+                    coin="round_robin",
+                    propose_empty=True,
+                    gc_depth=24,
+                    sync_patience=0,  # see ClusterLoadDriver docstring
+                ),
+                transport=FaultyTransport(
+                    FaultPlan(delay=0.05, duplicate=0.02, seed=10)
+                ),
+            )
+            gen = LoadGenerator(
+                clients=2 * mpc_n,
+                rate=40_000.0,
+                tx_bytes=32,
+                seed=10,
+                profile="burst",
+            )
+            # pool sized to saturate: the burst peaks MUST overflow the
+            # watermarks or the rung proves nothing about shedding
+            drv = ClusterLoadDriver(
+                sim,
+                gen,
+                mcfg=_MpMCfg(
+                    cap=512, batch_bytes=512, max_batch_txs=64
+                ),
+                dt=0.02,
+            )
+            entry = drv.run(mpc_secs, drain_s=20.0)
+            sim.check_agreement()
+            audit = entry["audit"]
+            entry["verifier"] = "none"
+            entry["agreement"] = True
+            entry["transport_faults"] = dict(sim.transport.stats)
+            result["ladder"]["mempool_chaos"] = entry
+            if audit["lost"] or audit["duplicates"]:
+                raise AssertionError(f"chaos audit failed: {audit}")
+            if not entry["shed_tx"]:
+                raise AssertionError(
+                    f"chaos rung never shed — not an overload run: {entry}"
+                )
+            _mark(
+                f"ladder mempool_chaos: {entry['offered_tx']} offered, "
+                f"{entry['accepted_tx']} accepted, {entry['shed_tx']} shed, "
+                f"lost {audit['lost']}, dups {audit['duplicates']}, "
+                f"agreement ok"
+            )
+            emit()
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder mempool_chaos FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder mempool_chaos (left {left():.0f}s)")
+
     # -- ladder rung #4: 256-node threshold coin with one Byzantine share
     if left() > 30:
         _mark("ladder coin256: keygen")
@@ -1506,8 +1651,9 @@ def main() -> None:
 
     budget = float(os.environ.get("DAGRIDER_BENCH_BUDGET", "540"))
     # enough for the n=256 phases (VERDICT r4 #6) + the dedup'd in-loop
-    # sim64 AND sim256 rungs the fallback now carries
-    cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "240"))
+    # sim64 AND sim256 rungs + the round-10 mempool e2e/chaos rungs the
+    # fallback now carries
+    cpu_reserve = float(os.environ.get("DAGRIDER_BENCH_CPU_RESERVE", "270"))
     notes = []
     # Critical diagnostics (mid-run truncation, probe-vs-record
     # mismatch) are kept separate and joined FIRST: the chronological
@@ -1560,6 +1706,15 @@ def main() -> None:
         env["DAGRIDER_BENCH_SIM256_SYNC_S"] = "0"
         env["DAGRIDER_BENCH_HOSTSIM_S"] = "12"  # host consensus evidence
         env["DAGRIDER_BENCH_HOSTSIM256_S"] = "15"
+        # Mempool end-to-end pipeline (round 10): client-visible
+        # committed-tx/s + submit→a_deliver percentiles at the flagship
+        # committee, null verifier. A 10 s load window + 30 s bounded
+        # drain fits the CPU box; the chaos variant (1 virtual second of
+        # 8x bursts through delay/duplicate faults) proves
+        # shed-not-crash on every record, chip or not.
+        env["DAGRIDER_BENCH_MEMPOOL_S"] = "10"
+        env["DAGRIDER_BENCH_MEMPOOL_DRAIN_S"] = "30"
+        env["DAGRIDER_BENCH_MEMPOOL_CHAOS_S"] = "1"
         env["DAGRIDER_BENCH_MSM_T"] = "0"
         env["DAGRIDER_BENCH_N1024"] = "0"
         env["DAGRIDER_BENCH_PALLAS"] = "0"  # Mosaic needs the real chip

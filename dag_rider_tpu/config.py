@@ -10,6 +10,7 @@ TPU-specific ones (verifier backend, device mesh shape).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 
@@ -134,3 +135,152 @@ class Config:
         if rnd < 1:
             raise ValueError("rounds >= 1 belong to waves; round 0 is genesis")
         return (rnd - 1) // self.wave_length + 1
+
+
+def _env_num(name: str, default: float, cast) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} must be a {cast.__name__}, got {raw!r}") from e
+
+
+@dataclasses.dataclass(frozen=True)
+class MempoolConfig:
+    """Knobs for the ingestion edge (``dag_rider_tpu/mempool/``).
+
+    Dataclass defaults < env < explicit :meth:`from_dict` values — so a
+    deployed fleet is retunable via environment without editing every
+    node's JSON config, and a config file still wins when it speaks up.
+
+    Env knobs: ``DAGRIDER_MEMPOOL_CAP`` (pool capacity, transactions),
+    ``DAGRIDER_BATCH_BYTES`` (target payload bytes per built block),
+    ``DAGRIDER_BATCH_DEADLINE_MS`` (max hold latency before a partial
+    batch ships), ``DAGRIDER_ADMIT_WATERMARKS`` ("low,high" pool-fill
+    fractions driving accept → throttle → shed), and
+    ``DAGRIDER_MEMPOOL_TTL_S`` (pending-transaction eviction age).
+
+    Attributes:
+        cap: max pending transactions the pool holds; adds beyond it shed.
+        batch_bytes: the batcher packs blocks up to this many payload
+            bytes (a single oversized transaction still ships alone).
+        batch_deadline_ms: a non-empty pool older than this flushes a
+            partial block — bounds client latency at low load.
+        admit_low / admit_high: pool-fill watermarks. Below low every
+            source is accepted (subject to ``source_rate``); between them
+            each source is throttled to ``throttle_rate`` tx/s; at or
+            above high everything sheds.
+        ttl_s: pending transactions older than this are evicted (they
+            were accepted but never packed — a stalled cluster must not
+            pin client payloads forever).
+        source_rate: per-source hard rate cap in tx/s applied even in
+            the accept band (0 = uncapped).
+        throttle_rate: per-source tx/s allowed inside the throttle band.
+        source_burst: token-bucket burst depth for both rate caps.
+        max_batch_txs: hard cap on transactions per built block (guards
+            the wire codec against pathological many-tiny-tx blocks).
+        max_staged_blocks: stop pulling built blocks into
+            ``Process.blocks_to_propose`` while it already holds this
+            many — DAG-Rider proposes ONE block per round, so under
+            sustained overload the proposal queue is the next unbounded
+            buffer after the pool; capping it keeps excess transactions
+            *in* the pool where the watermarks can see them and shed.
+    """
+
+    cap: int = 65536
+    batch_bytes: int = 8192
+    batch_deadline_ms: float = 50.0
+    admit_low: float = 0.5
+    admit_high: float = 0.9
+    ttl_s: float = 60.0
+    source_rate: float = 0.0
+    throttle_rate: float = 64.0
+    source_burst: float = 32.0
+    max_batch_txs: int = 1024
+    max_staged_blocks: int = 16
+
+    def __post_init__(self) -> None:
+        if self.cap < 1:
+            raise ValueError(f"mempool cap must be >= 1, got {self.cap}")
+        if self.batch_bytes < 1:
+            raise ValueError(
+                f"batch_bytes must be >= 1, got {self.batch_bytes}"
+            )
+        if self.batch_deadline_ms < 0:
+            raise ValueError(
+                f"batch_deadline_ms must be >= 0, got {self.batch_deadline_ms}"
+            )
+        if not 0.0 <= self.admit_low <= self.admit_high <= 1.0:
+            raise ValueError(
+                "admission watermarks need 0 <= low <= high <= 1, got "
+                f"low={self.admit_low}, high={self.admit_high}"
+            )
+        if self.ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {self.ttl_s}")
+        if self.source_rate < 0:
+            raise ValueError(
+                f"source_rate must be >= 0, got {self.source_rate}"
+            )
+        if self.throttle_rate <= 0:
+            raise ValueError(
+                f"throttle_rate must be > 0, got {self.throttle_rate}"
+            )
+        if self.source_burst < 1:
+            raise ValueError(
+                f"source_burst must be >= 1, got {self.source_burst}"
+            )
+        if self.max_batch_txs < 1:
+            raise ValueError(
+                f"max_batch_txs must be >= 1, got {self.max_batch_txs}"
+            )
+        if self.max_staged_blocks < 1:
+            raise ValueError(
+                f"max_staged_blocks must be >= 1, got {self.max_staged_blocks}"
+            )
+
+    @classmethod
+    def from_env(cls) -> "MempoolConfig":
+        low, high = cls._env_watermarks()
+        return cls(
+            cap=int(_env_num("DAGRIDER_MEMPOOL_CAP", cls.cap, int)),
+            batch_bytes=int(
+                _env_num("DAGRIDER_BATCH_BYTES", cls.batch_bytes, int)
+            ),
+            batch_deadline_ms=_env_num(
+                "DAGRIDER_BATCH_DEADLINE_MS", cls.batch_deadline_ms, float
+            ),
+            admit_low=low,
+            admit_high=high,
+            ttl_s=_env_num("DAGRIDER_MEMPOOL_TTL_S", cls.ttl_s, float),
+        )
+
+    @staticmethod
+    def _env_watermarks() -> Tuple[float, float]:
+        raw = os.environ.get("DAGRIDER_ADMIT_WATERMARKS", "").strip()
+        if not raw:
+            return MempoolConfig.admit_low, MempoolConfig.admit_high
+        parts = raw.split(",")
+        if len(parts) != 2:
+            raise ValueError(
+                f'DAGRIDER_ADMIT_WATERMARKS must be "low,high", got {raw!r}'
+            )
+        return float(parts[0]), float(parts[1])
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "MempoolConfig":
+        """Env-seeded config with explicit overrides; unknown keys raise
+        (a typo'd knob silently falling back to defaults is exactly the
+        class of config bug this repo's explicit-knob rule exists to
+        kill)."""
+        base = dataclasses.asdict(cls.from_env())
+        if d:
+            fields = {f.name for f in dataclasses.fields(cls)}
+            unknown = set(d) - fields
+            if unknown:
+                raise ValueError(
+                    f"unknown mempool config keys: {sorted(unknown)}"
+                )
+            base.update(d)
+        return cls(**base)

@@ -158,6 +158,37 @@ class Simulation:
                     Block((f"p{p.index}-blk{k}".encode().ljust(tx_bytes, b"."),))
                 )
 
+    def attach_mempools(self, mcfg=None, *, clock=None) -> list:
+        """One Mempool front door per process (round 10): each process's
+        a_deliver callback is wrapped so its mempool closes the
+        submit→a_deliver latency books, and the mempool's gauges land in
+        that process's metrics snapshot. Returns the mempools; drive
+        load through them with mempool.loadgen.ClusterLoadDriver (or by
+        hand: ``mp.submit(...)`` then feed ``mp.build_blocks()`` into
+        ``processes[i].submit``)."""
+        import time as _time
+
+        from dag_rider_tpu.mempool import Mempool
+
+        self.mempools = [
+            Mempool(
+                mcfg,
+                clock=clock if clock is not None else _time.monotonic,
+                metrics=p.metrics,
+            )
+            for p in self.processes
+        ]
+        for p, mp in zip(self.processes, self.mempools):
+            prev = p.on_deliver
+
+            def _deliver(v, prev=prev, mp=mp):
+                if prev is not None:
+                    prev(v)
+                mp.observe_delivered(v.block)
+
+            p.on_deliver = _deliver
+        return self.mempools
+
     def run(self, max_messages: int = 100_000) -> int:
         """Start everyone, then pump to quiescence in *bursts*: deliver
         every queued message, then step each process once. Returns messages

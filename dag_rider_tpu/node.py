@@ -36,7 +36,22 @@ Config (JSON):
 
   "checkpoint_dir": "ckpt/node0",  // optional, periodic + on shutdown
   "checkpoint_every_s": 30,
-  "submit_interval_s": 0.5         // synthetic client load (0: none)
+  "submit_interval_s": 0.5,        // synthetic client load (0: none)
+
+  "mempool": true,                 // round 10: admission + batching
+                                   // front door (dag_rider_tpu/mempool).
+                                   // true = env-tuned knobs
+                                   // (DAGRIDER_MEMPOOL_CAP etc.), or a
+                                   // dict of MempoolConfig overrides:
+                                   // {"cap": 65536, "batch_bytes": 8192,
+                                   //  "batch_deadline_ms": 50, ...}.
+                                   // Absent/false = the legacy direct
+                                   // one-block-per-submit path.
+  "auto_propose": false            // explicit gate on the synthetic
+                                   // n{i}-auto-{seq} generator; defaults
+                                   // ON only when no mempool is attached
+                                   // (load tests through the mempool
+                                   // must measure injected traffic only)
 }
 """
 
@@ -48,7 +63,8 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Deque, Dict, Optional
 
 from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus.coin import FixedCoin, RoundRobinCoin, ThresholdCoin
@@ -312,6 +328,7 @@ class Node:
             coin = RoundRobinCoin(n)
 
         self.delivered = []
+        self.mempool = None
         self.process = Process(
             self.ccfg,
             index,
@@ -319,9 +336,24 @@ class Node:
             coin=coin,
             verifier=verifier,
             signer=VertexSigner(seeds[index]),
-            on_deliver=self.delivered.append,
+            on_deliver=self._on_deliver,
             log=self.log,
         )
+        # Round-10 ingestion edge: "mempool": true (env-tuned) or a dict
+        # of MempoolConfig overrides attaches the admission + batching
+        # front door; submit() then routes through it and the pump pulls
+        # built blocks. Absent/false keeps the legacy direct-block path.
+        mp_cfg = cfg.get("mempool")
+        if mp_cfg:
+            from dag_rider_tpu.config import MempoolConfig
+            from dag_rider_tpu.mempool import Mempool
+
+            self.mempool = Mempool(
+                MempoolConfig.from_dict(
+                    mp_cfg if isinstance(mp_cfg, dict) else None
+                ),
+                metrics=self.process.metrics,
+            )
         self.net.attach_metrics(self.process.metrics)
         self.ckpt_dir = cfg.get("checkpoint_dir")
         self.ckpt_every = float(cfg.get("checkpoint_every_s", 30))
@@ -329,30 +361,56 @@ class Node:
         #: fetch runs on the pump thread (one candidate per cycle)
         self.snapshot_timeout_s = float(cfg.get("snapshot_timeout_s", 5.0))
         self.submit_interval = float(cfg.get("submit_interval_s", 0))
+        #: the synthetic n{i}-auto-{seq} generator gate: default ON only
+        #: without a mempool (legacy behavior); with one attached, load
+        #: tests must measure injected traffic only, so the generator
+        #: needs an explicit opt-in
+        self.auto_propose = bool(
+            cfg.get("auto_propose", self.mempool is None)
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._submit_lock = threading.Lock()
-        self._submit_queue: list = []
+        self._submit_queue: Deque[Block] = deque()
         self._stopped = False
 
         if self.ckpt_dir and checkpoint.latest_round(self.ckpt_dir) is not None:
-            checkpoint.restore(self.process, self.ckpt_dir)
+            checkpoint.restore(
+                self.process, self.ckpt_dir, mempool=self.mempool
+            )
             self.log.event("restored", round=self.process.round)
 
-    def submit(self, block: Block) -> None:
-        """Client API: enqueue a block for proposal. Thread-safe: the
-        block lands in a handoff queue the pump thread drains — Process
-        state is only ever touched from the pump thread (a caller-thread
-        process.submit racing the pump's step() corrupted state rarely
-        enough to be a flaky-suite heisenbug). After stop() the queue is
-        never drained again, so a late submit raises instead of silently
-        swallowing the block (ADVICE r3)."""
+    def _on_deliver(self, vertex) -> None:
+        self.delivered.append(vertex)
+        if self.mempool is not None:
+            # close the submit→a_deliver latency books for our payloads
+            self.mempool.observe_delivered(vertex.block)
+
+    def submit(self, block: Block, *, client: str = "client0"):
+        """Client API — the mempool front door (round 10). With a
+        mempool attached the block's transactions go through admission
+        (accept/throttle/shed) into the pool, and the returned
+        SubmitResult carries the backpressure signal: overload sheds
+        and reports, it does not raise. Without one, the legacy direct
+        path: the block lands whole in a handoff queue the pump thread
+        drains — Process state is only ever touched from the pump
+        thread (a caller-thread process.submit racing the pump's step()
+        corrupted state rarely enough to be a flaky-suite heisenbug).
+        Either way, after stop() nothing is drained again, so a late
+        submit raises instead of silently swallowing the block
+        (ADVICE r3)."""
         with self._submit_lock:
             if self._stopped:
                 raise RuntimeError(
                     f"node {self.process.index} is stopped; block not accepted"
                 )
-            self._submit_queue.append(block)
+            if self.mempool is None:
+                self._submit_queue.append(block)
+                return None
+            # under the same lock as the stop check: a submit racing
+            # stop() must not slip into the pool after the shutdown
+            # checkpoint already persisted it
+            return self.mempool.submit(block.transactions, client=client)
 
     def start(self) -> None:
         self.process.defer_steps = True
@@ -385,25 +443,46 @@ class Node:
             # but never silently: the dropped block and stranded
             # remainder need a trace.
             self.log.event("stop_drain_error", error=repr(e)[:200])
+        if self.mempool is not None:
+            # final gauge refresh so the post-stop snapshot is current
+            self.process.metrics.observe_mempool(self.mempool.stats())
         if self.ckpt_dir:
-            checkpoint.save(self.process, self.ckpt_dir)
+            # pending mempool transactions ride mempool.json in the same
+            # checkpoint: accepted traffic survives the restart
+            checkpoint.save(self.process, self.ckpt_dir, mempool=self.mempool)
         self.net.close()
 
     def _pump_loop(self) -> None:
-        last_ckpt = last_submit = time.monotonic()
+        last_ckpt = last_submit = last_gauge = time.monotonic()
         seq = 0
         while not self._stop.is_set():
             try:
                 self._pump_once()
                 now = time.monotonic()
                 if (
-                    self.submit_interval
+                    self.auto_propose
+                    and self.submit_interval
                     and now - last_submit >= self.submit_interval
                 ):
                     last_submit = now
                     seq += 1
-                    self.process.submit(
-                        Block((f"n{self.process.index}-auto-{seq}".encode(),))
+                    payload = f"n{self.process.index}-auto-{seq}".encode()
+                    if self.mempool is not None:
+                        # explicit auto_propose with a mempool: the
+                        # synthetic load takes the front door too, so it
+                        # shows up in the same gauges as real traffic
+                        self.mempool.submit(
+                            (payload,),
+                            client=f"auto{self.process.index}",
+                        )
+                    else:
+                        self.process.submit(Block((payload,)))
+                if self.mempool is not None and now - last_gauge >= 1.0:
+                    # stats() is counter reads, but snapshot consumers
+                    # only need ~1 Hz freshness — keep it off the hot loop
+                    last_gauge = now
+                    self.process.metrics.observe_mempool(
+                        self.mempool.stats()
                     )
                 if (
                     self.ckpt_dir
@@ -411,7 +490,9 @@ class Node:
                     and now - last_ckpt >= self.ckpt_every
                 ):
                     last_ckpt = now
-                    checkpoint.save(self.process, self.ckpt_dir)
+                    checkpoint.save(
+                        self.process, self.ckpt_dir, mempool=self.mempool
+                    )
                     self.log.event("checkpointed", round=self.process.round)
             except Exception as e:  # noqa: BLE001 — a BFT node must not
                 # die silently: before this guard, any exception
@@ -427,20 +508,31 @@ class Node:
         """Move queued client blocks into the Process, one at a time; on
         an exception the not-yet-processed remainder goes back to the
         front of the queue (the failing block is dropped and logged —
-        retrying it forever would livelock the pump)."""
+        retrying it forever would livelock the pump). Deques at both
+        ends: the old list's pop(0) drain was O(n) per block."""
         with self._submit_lock:
-            pending, self._submit_queue = self._submit_queue, []
+            pending, self._submit_queue = self._submit_queue, deque()
         while pending:
-            block = pending.pop(0)
+            block = pending.popleft()
             try:
                 self.process.submit(block)
             except Exception:
                 with self._submit_lock:
-                    self._submit_queue = pending + self._submit_queue
+                    pending.extend(self._submit_queue)
+                    self._submit_queue = pending
                 raise
 
     def _pump_once(self) -> None:
         self._drain_submissions()
+        if self.mempool is not None:
+            # the pump pulls BUILT blocks (size-or-deadline batches), not
+            # raw submissions — the round-10 front-door contract; staged=
+            # current proposal backlog so overload stays in the pool
+            # (bounded, sheddable) instead of blocks_to_propose (neither)
+            for block in self.mempool.build_blocks(
+                staged=len(self.process.blocks_to_propose)
+            ):
+                self.process.submit(block)
         if self.process.state_transfer_needed:
             self._state_transfer()
         moved = self.net.pump(256)

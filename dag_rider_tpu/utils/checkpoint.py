@@ -26,14 +26,22 @@ from dag_rider_tpu.core.types import Block, VertexID
 MANIFEST = "manifest.json"
 TENSORS = "dag.npz"
 VERTICES = "vertices.bin"
+MEMPOOL = "mempool.json"
 
 
-def save(process, path: str) -> None:
+def save(process, path: str, *, mempool=None) -> None:
     """Write a consistent snapshot of ``process`` into directory ``path``.
 
     Must be called from the process's own thread (the state machine is
     synchronous — SURVEY.md D4's fix keeps all mutation on one thread, so
     a call between step()s sees a consistent state).
+
+    ``mempool`` (round 10): a :class:`dag_rider_tpu.mempool.Mempool`
+    whose pending (accepted-but-not-yet-batched) transactions ride a
+    sibling ``mempool.json`` — restart must lose no accepted
+    transaction. Batched-but-undelivered payloads are already covered
+    by ``blocks_to_propose`` / the DAG payloads in this snapshot, so
+    pool + manifest together account for every accepted byte.
     """
     os.makedirs(path, exist_ok=True)
     exists, strong = process.dag.dense_snapshot()
@@ -77,13 +85,24 @@ def save(process, path: str) -> None:
     with open(tmp, "w") as fh:
         json.dump(manifest, fh)
     os.replace(tmp, os.path.join(path, MANIFEST))
+    if mempool is not None:
+        # same atomic-rename discipline as the manifest: a crash
+        # mid-write must leave the previous pending set readable
+        mtmp = os.path.join(path, MEMPOOL + ".tmp")
+        with open(mtmp, "w") as fh:
+            json.dump(mempool.checkpoint_state(), fh)
+        os.replace(mtmp, os.path.join(path, MEMPOOL))
 
 
-def restore(process, path: str) -> None:
+def restore(process, path: str, *, mempool=None) -> None:
     """Load a snapshot into a freshly constructed (same cfg/index) Process.
 
     The process must not have been started; its genesis-only DAG is
     replaced wholesale by the checkpointed one.
+
+    ``mempool``: re-admits the checkpoint's pending transaction set
+    (see :func:`save`); checkpoints written before round 10 have no
+    ``mempool.json`` and restore cleanly with an empty pool.
     """
     with open(os.path.join(path, MANIFEST)) as fh:
         manifest = json.load(fh)
@@ -174,6 +193,11 @@ def restore(process, path: str) -> None:
         process.blocks_to_propose.append(
             Block(tuple(bytes.fromhex(tx) for tx in txs))
         )
+    if mempool is not None:
+        mp_path = os.path.join(path, MEMPOOL)
+        if os.path.exists(mp_path):
+            with open(mp_path) as fh:
+                mempool.restore_state(json.load(fh))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,490 @@
+"""Round-10 mempool subsystem.
+
+Unit coverage for the three stages (pool / admission / batcher), the
+facade's in-flight dedup + staged-blocks backpressure, the seeded load
+generator, and the Histogram helper — then the e2e properties the
+subsystem exists for: open-loop overload sheds-not-crashes with zero
+lost accepted transactions, batched delivery order is byte-identical to
+the legacy one-block path under identical payload bytes, and a process
+killed mid-load resumes from its checkpoint with every accepted
+transaction intact and nothing delivered twice.
+"""
+
+import pytest
+
+from dag_rider_tpu.config import Config, MempoolConfig
+from dag_rider_tpu.consensus.process import Process
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core.types import Block
+from dag_rider_tpu.mempool import Mempool
+from dag_rider_tpu.mempool.admission import (
+    ACCEPT,
+    SHED,
+    THROTTLE,
+    AdmissionController,
+)
+from dag_rider_tpu.mempool.batcher import BlockBatcher
+from dag_rider_tpu.mempool.loadgen import (
+    ClusterLoadDriver,
+    LoadGenerator,
+    replay,
+    smoke,
+)
+from dag_rider_tpu.mempool.pool import TransactionPool
+from dag_rider_tpu.transport.memory import InMemoryTransport
+from dag_rider_tpu.utils import checkpoint
+from dag_rider_tpu.utils.metrics import Histogram
+
+SIM_CFG = dict(
+    coin="round_robin",
+    propose_empty=True,
+    gc_depth=24,
+    # the driver's chunked pumping reads as a partition to anti-entropy
+    # (see ClusterLoadDriver docstring)
+    sync_patience=0,
+)
+
+
+# -- config -----------------------------------------------------------------
+
+
+def test_mempool_config_validates():
+    for bad in (
+        dict(cap=0),
+        dict(batch_bytes=0),
+        dict(batch_deadline_ms=-1.0),
+        dict(admit_low=0.9, admit_high=0.5),
+        dict(admit_high=1.5),
+        dict(ttl_s=0.0),
+        dict(source_rate=-1.0),
+        dict(throttle_rate=0.0),
+        dict(max_batch_txs=0),
+        dict(max_staged_blocks=0),
+    ):
+        with pytest.raises(ValueError):
+            MempoolConfig(**bad)
+
+
+def test_mempool_config_env_and_dict(monkeypatch):
+    monkeypatch.setenv("DAGRIDER_MEMPOOL_CAP", "123")
+    monkeypatch.setenv("DAGRIDER_BATCH_BYTES", "456")
+    monkeypatch.setenv("DAGRIDER_BATCH_DEADLINE_MS", "7.5")
+    monkeypatch.setenv("DAGRIDER_ADMIT_WATERMARKS", "0.3,0.7")
+    cfg = MempoolConfig.from_env()
+    assert (cfg.cap, cfg.batch_bytes) == (123, 456)
+    assert (cfg.batch_deadline_ms, cfg.admit_low, cfg.admit_high) == (
+        7.5,
+        0.3,
+        0.7,
+    )
+    # dict overrides layer on top of the env base
+    cfg2 = MempoolConfig.from_dict({"cap": 9})
+    assert cfg2.cap == 9 and cfg2.batch_bytes == 456
+    with pytest.raises(ValueError):
+        MempoolConfig.from_dict({"nope": 1})
+    monkeypatch.setenv("DAGRIDER_ADMIT_WATERMARKS", "bogus")
+    with pytest.raises(ValueError):
+        MempoolConfig.from_env()
+
+
+# -- pool -------------------------------------------------------------------
+
+
+def test_pool_dedup_fifo_and_round_robin():
+    pool = TransactionPool(MempoolConfig(cap=16, batch_bytes=1024))
+    assert pool.add(b"a1", "a", 0.0) == "ok"
+    assert pool.add(b"a1", "a", 0.0) == "dup"
+    assert pool.add(b"a2", "a", 0.0) == "ok"
+    assert pool.add(b"b1", "b", 0.0) == "ok"
+    # take is round-robin one-per-lane: lanes interleave, each lane FIFO
+    txs = pool.take(1024, 3)
+    assert sorted(txs) == [b"a1", b"a2", b"b1"]
+    assert txs.index(b"a1") < txs.index(b"a2")
+    assert len(pool) == 0
+
+
+def test_pool_cap_and_ttl():
+    pool = TransactionPool(MempoolConfig(cap=2, batch_bytes=64, ttl_s=5.0))
+    assert pool.add(b"x", "c", 0.0) == "ok"
+    assert pool.add(b"y", "c", 0.0) == "ok"
+    assert pool.add(b"z", "c", 0.0) == "full"
+    assert pool.dropped_full == 1
+    assert pool.expire(4.9) == []
+    expired = pool.expire(5.1)
+    assert sorted(expired) == [b"x", b"y"]
+    assert len(pool) == 0 and pool.expired == 2
+
+
+def test_pool_oversized_tx_ships_alone():
+    pool = TransactionPool(MempoolConfig(cap=8, batch_bytes=16))
+    big = b"B" * 64
+    pool.add(big, "c", 0.0)
+    pool.add(b"small", "c", 0.0)
+    assert pool.take(16, 8) == [big]  # never wedges, ships alone
+    assert pool.take(16, 8) == [b"small"]
+
+
+def test_pool_restore_preserves_lanes():
+    cfg = MempoolConfig(cap=8, batch_bytes=64)
+    pool = TransactionPool(cfg)
+    pool.add(b"t1", "a", 0.0)
+    pool.add(b"t2", "b", 0.0)
+    entries = [(e.client, e.tx) for e in pool.pending()]
+    fresh = TransactionPool(cfg)
+    assert fresh.restore(entries, 1.0) == 2
+    assert [(e.client, e.tx) for e in fresh.pending()] == entries
+
+
+# -- admission --------------------------------------------------------------
+
+
+def test_admission_watermark_ladder():
+    cfg = MempoolConfig(admit_low=0.5, admit_high=0.9, throttle_rate=2.0)
+    adm = AdmissionController(cfg)
+    assert adm.decide("c", 0.1, 0.0) and adm.state == ACCEPT
+    # throttle band: token bucket at throttle_rate caps the source
+    assert adm.decide("c", 0.7, 1.0) and adm.state == THROTTLE
+    burst = sum(adm.decide("c", 0.7, 1.0) for _ in range(100))
+    assert burst < 100  # the bucket ran dry
+    assert not adm.decide("c", 0.95, 2.0) and adm.state == SHED
+    assert adm.shed_watermark >= 1
+
+
+def test_admission_per_source_rate_cap():
+    cfg = MempoolConfig(source_rate=5.0, source_burst=5.0)
+    adm = AdmissionController(cfg)
+    ok_a = sum(adm.decide("a", 0.0, 0.0) for _ in range(50))
+    assert ok_a == 5  # burst allowance, then dry at t=0
+    assert adm.shed_rate == 45
+    # an independent source has its own bucket
+    assert adm.decide("b", 0.0, 0.0)
+    # refill: one second at 5/s buys 5 more
+    assert sum(adm.decide("a", 0.0, 1.0) for _ in range(50)) == 5
+
+
+# -- batcher ----------------------------------------------------------------
+
+
+def _packed(cfg=None, txs=()):
+    cfg = cfg or MempoolConfig(cap=64, batch_bytes=64, batch_deadline_ms=50.0)
+    pool = TransactionPool(cfg)
+    for i, tx in enumerate(txs):
+        pool.add(tx, f"c{i % 2}", 0.0)
+    return cfg, pool, BlockBatcher(cfg, pool)
+
+
+def test_batcher_size_and_deadline_triggers():
+    _, _, b = _packed(txs=[b"x" * 32, b"y" * 32])  # 64 bytes => size fires
+    assert b.ready(0.0)
+    blk = b.build(0.0)
+    assert blk is not None and len(blk.transactions) == 2
+    _, _, b2 = _packed(txs=[b"z" * 8])  # under batch_bytes
+    assert not b2.ready(0.01)  # 10ms < 50ms deadline
+    assert b2.ready(0.06)  # deadline fired: partial block ships
+    assert b2.build(0.06) is not None
+
+
+def test_batcher_drain_partial_once_and_limit():
+    cfg, pool, b = _packed(
+        txs=[bytes([i]) * 32 for i in range(9)]
+    )  # 288 bytes = 4 full blocks + 1 straggler
+    out = b.drain(99.0)  # deadline long past
+    # 4 size-triggered blocks; the straggler waits for the NEXT deadline
+    # (one partial per call, and the first block already used the fire)
+    assert len(out) == 4 and len(pool) == 1
+    assert len(b.drain(99.0)) == 1  # next cycle: the deadline partial
+    assert len(pool) == 0
+    cfg2, pool2, b2 = _packed(txs=[bytes([i]) * 32 for i in range(8)])
+    assert len(b2.drain(99.0, limit=2)) == 2
+    assert len(pool2) == 4  # the rest stays pooled
+    assert len(b2.drain(0.0, force=True)) == 2
+    assert 0.9 <= b2.mean_fill() <= 1.0
+
+
+# -- histogram --------------------------------------------------------------
+
+
+def test_histogram_percentiles_nearest_rank():
+    h = Histogram()
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(50) == 50.0
+    assert h.percentile(99) == 99.0
+    assert h.percentile(100) == 100.0
+    assert h.mean() == pytest.approx(50.5)
+    with pytest.raises(ValueError):
+        Histogram().percentile(50)
+
+
+# -- mempool facade ---------------------------------------------------------
+
+
+def test_mempool_inflight_dedup_until_delivered():
+    mp = Mempool(MempoolConfig(cap=64, batch_bytes=8, batch_deadline_ms=0.0))
+    assert mp.submit((b"tx-dup",), now=0.0).accepted == 1
+    assert mp.submit((b"tx-dup",), now=0.0).deduped == 1  # still pooled
+    blocks = mp.build_blocks(now=1.0)
+    assert blocks and b"tx-dup" in blocks[0].transactions
+    # batched-awaiting-delivery: STILL deduped (would deliver twice)
+    assert mp.submit((b"tx-dup",), now=1.0).deduped == 1
+    mp.observe_delivered(blocks[0], now=2.0)
+    assert mp.delivered_txs == 1 and len(mp.latency) == 1
+    # books closed: the payload may now be resubmitted
+    assert mp.submit((b"tx-dup",), now=3.0).accepted == 1
+
+
+def test_mempool_staged_backpressure_gate():
+    cfg = MempoolConfig(
+        cap=1024, batch_bytes=8, batch_deadline_ms=0.0, max_staged_blocks=4
+    )
+    mp = Mempool(cfg)
+    mp.submit([f"t{i:03d}".encode() for i in range(64)], now=0.0)
+    assert mp.build_blocks(now=0.0, staged=4) == []  # backlog full: hold
+    assert len(mp.build_blocks(now=0.0, staged=3)) == 1
+    assert len(mp.build_blocks(now=0.0, staged=0)) == 4
+    # force (shutdown flush) ignores the bound
+    assert len(mp.build_blocks(now=0.0, staged=99, force=True)) > 4
+    assert mp.pool.depth_bytes == 0
+
+
+def test_mempool_stats_and_checkpoint_roundtrip():
+    mp = Mempool(MempoolConfig(cap=8, batch_bytes=1024))
+    mp.submit((b"aaaa", b"bbbb"), client="c1", now=0.0)
+    stats = mp.stats()
+    assert stats["depth"] == 2 and stats["admitted"] == 2
+    for key in ("shed", "batch_fill", "state", "delivered_txs"):
+        assert key in stats
+    state = mp.checkpoint_state()
+    fresh = Mempool(mp.cfg)
+    assert fresh.restore_state(state, now=5.0) == 2
+    assert {e.tx for e in fresh.pool.pending()} == {b"aaaa", b"bbbb"}
+    # restored entries re-enter the in-flight dedup horizon
+    assert fresh.submit((b"aaaa",), now=5.0).deduped == 1
+
+
+# -- load generator ---------------------------------------------------------
+
+
+def test_loadgen_is_seed_deterministic():
+    def first_events(seed):
+        gen = LoadGenerator(clients=4, rate=500.0, seed=seed)
+        return gen.events_until(1.0)
+
+    assert first_events(3) == first_events(3)
+    assert first_events(3) != first_events(4)
+
+
+def test_loadgen_burst_profile_spikes():
+    gen = LoadGenerator(
+        clients=4,
+        rate=1000.0,
+        seed=1,
+        profile="burst",
+        burst_factor=8.0,
+        burst_every_s=1.0,
+        burst_len_s=0.25,
+    )
+    in_burst = len(gen.events_until(0.25))  # burst window [0, 0.25)
+    off_burst = len(gen.events_until(1.0)) # off window [0.25, 1.0)
+    # 8x rate over the window: the spike must dominate per-second rate
+    assert in_burst / 0.25 > 2 * (off_burst / 0.75)
+
+
+def test_loadgen_rejects_bad_profile():
+    with pytest.raises(ValueError):
+        LoadGenerator(profile="nope")
+    with pytest.raises(ValueError):
+        LoadGenerator(rate=0.0)
+
+
+# -- end to end -------------------------------------------------------------
+
+
+def test_smoke_cluster_commits_under_burst():
+    rep = smoke(n=4, seconds=1.0, rate=2000.0, seed=7)
+    assert rep["committed_tx"] > 0
+    assert rep["audit"]["lost"] == 0 and rep["audit"]["duplicates"] == 0
+
+
+def test_overload_sheds_not_crashes():
+    sim = Simulation(Config(n=4, **SIM_CFG))
+    gen = LoadGenerator(clients=8, rate=20_000.0, seed=3, profile="burst")
+    drv = ClusterLoadDriver(
+        sim,
+        gen,
+        mcfg=MempoolConfig(
+            cap=256, batch_bytes=256, batch_deadline_ms=20.0, max_batch_txs=64
+        ),
+    )
+    rep = drv.run(1.0)
+    sim.check_agreement()
+    audit = rep["audit"]
+    assert rep["shed_tx"] > 0, "overload run never shed"
+    assert audit["lost"] == 0 and audit["duplicates"] == 0
+
+
+def test_batched_delivery_byte_identical_to_legacy_path():
+    """Acceptance: same payload bytes through the batcher vs fed directly
+    to Process.submit (legacy one-block path) deliver in the SAME order,
+    byte for byte."""
+    sim = Simulation(Config(n=4, **SIM_CFG))
+    gen = LoadGenerator(clients=8, rate=3000.0, seed=11)
+    drv = ClusterLoadDriver(
+        sim,
+        gen,
+        mcfg=MempoolConfig(cap=4096, batch_bytes=256, batch_deadline_ms=20.0),
+    )
+    drv.run(1.0)
+    sim.check_agreement()
+    batched = drv.delivered_txs(0)
+    assert batched, "nothing committed in the batched run"
+
+    sim2 = Simulation(Config(n=4, **SIM_CFG))
+    replay(sim2, drv.submission_log)
+    sim2.check_agreement()
+    accepted = drv.accepted
+    legacy = [
+        tx
+        for v in sim2.deliveries[0]
+        for tx in v.block.transactions
+        if tx in accepted
+    ]
+    assert batched == legacy
+
+
+def test_chaos_transport_zero_loss():
+    from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
+
+    sim = Simulation(
+        Config(n=4, **SIM_CFG),
+        transport=FaultyTransport(
+            FaultPlan(delay=0.05, duplicate=0.05, seed=2)
+        ),
+    )
+    gen = LoadGenerator(clients=8, rate=4000.0, seed=2, profile="burst")
+    drv = ClusterLoadDriver(
+        sim,
+        gen,
+        mcfg=MempoolConfig(cap=512, batch_bytes=256, batch_deadline_ms=20.0),
+    )
+    rep = drv.run(1.0)
+    sim.check_agreement()
+    audit = rep["audit"]
+    assert sim.transport.stats["delayed"] > 0  # faults actually fired
+    assert audit["lost"] == 0 and audit["duplicates"] == 0
+    assert rep["committed_tx"] > 0
+
+
+def test_checkpoint_resume_under_load(tmp_path):
+    """Satellite 4: kill a process mid-loadgen and restore — every
+    accepted transaction survives (pending set intact, delivered prefix
+    intact) and nothing already a_delivered reappears as pending."""
+    cfg = Config(n=4, **SIM_CFG)
+    sim = Simulation(cfg)
+    gen = LoadGenerator(clients=8, rate=4000.0, seed=5, profile="burst")
+    drv = ClusterLoadDriver(
+        sim,
+        gen,
+        mcfg=MempoolConfig(cap=4096, batch_bytes=256, batch_deadline_ms=20.0),
+    )
+    drv.run(0.5, drain=False)  # mid-flight, pools still loaded
+    mp0, p0 = drv.mempools[0], sim.processes[0]
+    pending_before = {e.tx for e in mp0.pool.pending()}
+    assert pending_before, "kill point must catch a non-empty pool"
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(p0, path, mempool=mp0)
+
+    # "crash": a brand-new process + mempool rebuilt purely from disk
+    p2 = Process(cfg, 0, InMemoryTransport())
+    mp2 = Mempool(mp0.cfg)
+    checkpoint.restore(p2, path, mempool=mp2)
+    assert {e.tx for e in mp2.pool.pending()} == pending_before
+    assert p2.delivered_log == p0.delivered_log
+    assert list(p2.blocks_to_propose) == list(p0.blocks_to_propose)
+    # nothing delivered twice: a_delivered payloads are NOT pending again
+    delivered = {
+        tx for v in sim.deliveries[0] for tx in v.block.transactions
+    }
+    assert not (pending_before & delivered)
+    # ... and the restored books still dedup a resubmission of them
+    staged = {tx for b in p2.blocks_to_propose for tx in b.transactions}
+    for tx in list(pending_before)[:3]:
+        assert mp2.submit((tx,), now=99.0).deduped == 1
+    # zero-loss across the kill for everything mempool 0 accepted: the
+    # loadgen payload head encodes its client ("s5c<k>-...") and client
+    # k feeds mempool k % n, so k in {0, 4} is exactly p0's intake.
+    # Every such tx must be delivered, pending again, staged for
+    # proposal, or riding a restored DAG vertex — nowhere is "gone".
+    in_dag = {
+        tx for v in p2.dag.vertices.values() for tx in v.block.transactions
+    }
+    p0_intake = {
+        tx for tx in drv.accepted if tx.split(b"-")[0] in (b"s5c0", b"s5c4")
+    }
+    assert p0_intake  # the scope is non-trivial
+    assert not (p0_intake - (delivered | pending_before | staged | in_dag))
+
+
+def test_checkpoint_without_mempool_restores_empty(tmp_path):
+    """Pre-round-10 checkpoints (no mempool.json) restore cleanly."""
+    cfg = Config(n=4)
+    sim = Simulation(cfg)
+    sim.submit_blocks(2)
+    sim.run(max_messages=200)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(sim.processes[0], path)  # no mempool kwarg
+    p2 = Process(cfg, 0, InMemoryTransport())
+    mp2 = Mempool(MempoolConfig(cap=64, batch_bytes=64))
+    checkpoint.restore(p2, path, mempool=mp2)
+    assert len(mp2.pool) == 0
+    assert p2.delivered_log == sim.processes[0].delivered_log
+
+
+# -- node wiring ------------------------------------------------------------
+
+
+def test_node_mempool_front_door_and_auto_propose(tmp_path):
+    from dag_rider_tpu import node as node_mod
+    from dag_rider_tpu.mempool import SubmitResult
+
+    keys_path = tmp_path / "keys.json"
+    node_mod.main(
+        ["keygen", "--n", "4", "--threshold", "2", "--out", str(keys_path)]
+    )
+    base = {
+        "n": 4,
+        "listen": "127.0.0.1:0",
+        "peers": {},
+        "keys": str(keys_path),
+        "rbc": False,
+        "verifier": "none",
+        "coin": "round_robin",
+    }
+    nd = node_mod.Node(
+        {**base, "index": 0, "mempool": {"cap": 99, "batch_bytes": 128}}
+    )
+    try:
+        assert nd.mempool is not None and nd.mempool.cfg.cap == 99
+        # satellite 2: auto-propose defaults OFF when a mempool fronts
+        # the node — client traffic decides what blocks carry
+        assert nd.auto_propose is False
+        res = nd.submit(Block((b"client-tx",)))
+        assert isinstance(res, SubmitResult) and res.accepted == 1
+        assert nd.submit(Block((b"client-tx",))).deduped == 1
+    finally:
+        nd.net.close()
+    # legacy node: no mempool, auto-propose stays on
+    nd2 = node_mod.Node({**base, "index": 1})
+    try:
+        assert nd2.mempool is None and nd2.auto_propose is True
+        assert nd2.submit(Block((b"legacy",))) is None
+        # explicit override wins over the default
+        nd3 = node_mod.Node(
+            {**base, "index": 2, "mempool": True, "auto_propose": True}
+        )
+        try:
+            assert nd3.mempool is not None and nd3.auto_propose is True
+        finally:
+            nd3.net.close()
+    finally:
+        nd2.net.close()
