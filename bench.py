@@ -981,7 +981,6 @@ def _measure() -> None:
                     coin="round_robin",
                     propose_empty=True,
                     gc_depth=24,
-                    sync_patience=0,  # see ClusterLoadDriver docstring
                 )
             )
             gen = LoadGenerator(
@@ -1041,7 +1040,6 @@ def _measure() -> None:
                     coin="round_robin",
                     propose_empty=True,
                     gc_depth=24,
-                    sync_patience=0,  # see ClusterLoadDriver docstring
                 ),
                 transport=FaultyTransport(
                     FaultPlan(delay=0.05, duplicate=0.02, seed=10)
@@ -1088,6 +1086,97 @@ def _measure() -> None:
             _mark(f"ladder mempool_chaos FAILED: {e!r}")
     else:
         _mark(f"skipping ladder mempool_chaos (left {left():.0f}s)")
+
+    # -- ladder rung: Byzantine adversary x WAN suite at committee scale.
+    # Every adversary class from consensus/adversary.py drives f=10 of
+    # n=32 nodes (f < n/3) through consensus/scenarios.py, plus a
+    # partition-then-heal WAN run — run_scenario RAISES unless agreement,
+    # commit-uniqueness, zero-loss, and the liveness floor all hold, so a
+    # recorded entry IS a passed invariant audit. The detection counters
+    # (equivocations_detected, edge_rejects, coin_filtered, sync_served)
+    # land in the entry so the record also proves each attack genuinely
+    # ran. garbage_coin is the expensive one (pure-Python pairings per
+    # filtered wave) and gets its own cycle cap.
+    byz_s = float(os.environ.get("DAGRIDER_BENCH_BYZ_S", "150"))
+    byz_n = int(os.environ.get("DAGRIDER_BENCH_BYZ_N", "32"))
+    byz_seed = int(os.environ.get("DAGRIDER_BENCH_BYZ_SEED", "0"))
+    if byz_s > 0 and left() > byz_s + 20:
+        from dag_rider_tpu.consensus.scenarios import Scenario, run_scenario
+
+        t_rung = time.monotonic()
+        byz_plan = [
+            # (scenario kwargs, per-scenario wall cap fraction)
+            dict(),
+            dict(wan="partition", min_waves=1, min_each=1),
+            dict(adversary="equivocate", min_waves=1, min_each=0),
+            dict(
+                adversary="equivocate_split",
+                cycles=12,
+                min_waves=1,
+                min_each=0,
+            ),
+            dict(adversary="withhold", min_waves=1, min_each=0),
+            dict(adversary="invalid_edges", min_waves=1, min_each=0),
+            dict(
+                adversary="garbage_coin",
+                cycles=4,
+                min_waves=1,
+                min_each=0,
+            ),
+        ]
+        rung: dict = {"n": byz_n, "seed": byz_seed, "scenarios": {}}
+        result["ladder"]["byzantine"] = rung
+        for kw in byz_plan:
+            if time.monotonic() - t_rung > byz_s or left() < 20:
+                _mark(
+                    f"ladder byzantine: budget spent, skipping "
+                    f"{kw.get('adversary') or 'clean'}/{kw.get('wan', 'lan')}"
+                )
+                continue
+            sc = Scenario(n=byz_n, seed=byz_seed, **kw)
+            _mark(f"ladder byzantine: {sc.name} (n={byz_n})")
+            t0 = time.monotonic()
+            try:
+                r = run_scenario(sc)
+                rung["scenarios"][sc.name] = {
+                    "adversary": r["adversary"],
+                    "wan": r["wan"],
+                    "rbc": r["rbc"],
+                    "coin": r["coin"],
+                    "byzantine": len(r["byzantine"]),
+                    "f": r["f"],
+                    "rounds": r["rounds"],
+                    "decided_waves": r["decided_waves"],
+                    "audit": r["audit"],
+                    "equivocations_detected": r["equivocations_detected"],
+                    "edge_rejects": r["edge_rejects"],
+                    "coin_filtered": r["coin_filtered"],
+                    "sync_requested": r["sync_requested"],
+                    "sync_served": r["sync_served"],
+                    "behavior": r["behavior"],
+                    "invariants": r["invariants"],
+                    "wall_s": round(time.monotonic() - t0, 2),
+                }
+                _mark(
+                    f"ladder byzantine: {sc.name} OK in "
+                    f"{time.monotonic() - t0:.1f}s — waves "
+                    f"{r['decided_waves']['min']}..{r['decided_waves']['max']}, "
+                    f"eq {r['equivocations_detected']}, edges "
+                    f"{r['edge_rejects']}, coin {r['coin_filtered']}"
+                )
+            except Exception as e:  # noqa: BLE001 — rung is best-effort
+                rung["scenarios"][sc.name] = {
+                    "failed": repr(e)[:300],
+                    "wall_s": round(time.monotonic() - t0, 2),
+                }
+                _mark(f"ladder byzantine: {sc.name} FAILED: {e!r}")
+        rung["wall_s"] = round(time.monotonic() - t_rung, 1)
+        rung["passed"] = sum(
+            1 for v in rung["scenarios"].values() if "failed" not in v
+        )
+        emit()
+    else:
+        _mark(f"skipping ladder byzantine (left {left():.0f}s)")
 
     # -- ladder rung #4: 256-node threshold coin with one Byzantine share
     if left() > 30:

@@ -105,6 +105,12 @@ class ThresholdCoin(CommonCoin):
         self._shares: dict = {}
         self._sigma: dict = {}
         self._tried_at: dict = {}
+        #: shares discarded by the batched bad-share filter, cumulative —
+        #: under SUSTAINED pollution (a garbage-share adversary feeding
+        #: junk every wave, consensus/adversary.py) this counts the
+        #: recovery work wave after wave; the single-bad-share case is
+        #: just its first increment
+        self.filtered = 0
 
     def my_share(self, wave: int):
         return self._th.sign_share(self.keys.share_sks[self.index], wave)
@@ -136,6 +142,7 @@ class ThresholdCoin(CommonCoin):
         good = self._th.batch_verify_shares(
             self.keys.share_pks, wave, shares, msm=self._msm
         )
+        self.filtered += len(shares) - len(good)
         self._shares[wave] = good
         if len(good) >= self.keys.threshold:
             sigma = self._th.aggregate(good, self.keys.threshold, msm=self._msm)

@@ -45,7 +45,7 @@ from dag_rider_tpu.consensus.coin import CommonCoin, FixedCoin, RoundRobinCoin
 from dag_rider_tpu.consensus.dag_state import DagState
 from dag_rider_tpu.core.stack import Stack
 from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
-from dag_rider_tpu.transport.base import Transport
+from dag_rider_tpu.transport.base import Transport, resolve_unicast
 from dag_rider_tpu.utils.metrics import Metrics, Timer
 from dag_rider_tpu.utils.slog import NOOP, EventLog
 
@@ -113,7 +113,15 @@ class Process:
         #: restore re-derives it via _rebuild_delivered_mask.
         self._delivered_mask = np.zeros_like(self.dag.exists)
         self._stuck_steps = 0
+        #: msgs_received watermark for backlog-aware sync patience — see
+        #: _maybe_request_sync (a node still being fed is throttled, not
+        #: partitioned)
+        self._rx_at_patience = 0
         self._sync_last_request = float("-inf")
+        #: round-robin cursor over peers for pull-based sync requests;
+        #: start offset by our index so n stuck nodes don't all probe
+        #: peer 0 in the same window
+        self._sync_peer_rr = index + 1
         self._sync_last_serve: Dict[int, float] = {}  # requester -> mono
         #: responder -> GC floor from sync_nack replies; f+1 distinct
         #: floors above our round flip state_transfer_needed (the node
@@ -538,12 +546,20 @@ class Process:
             v = self._create_vertex(self.round)
             self.dag.insert(v)
             self._seen_digests[v.id] = v.digest()
-            self.transport.broadcast(
-                BroadcastMessage(vertex=v, round=v.round, sender=self.index)
-            )
+            self._broadcast_vertex(v)
             self.metrics.inc("vertices_proposed")
             advanced = True
         return advanced
+
+    def _broadcast_vertex(self, v: Vertex) -> None:
+        """Dissemination seam for own proposals. The local DAG already
+        holds ``v`` (state first, wire second), so an override that
+        mutates, withholds, or splits what goes on the wire — the
+        Byzantine strategies in consensus/adversary.py — cannot corrupt
+        this process's own dense mirrors, only test its peers."""
+        self.transport.broadcast(
+            BroadcastMessage(vertex=v, round=v.round, sender=self.index)
+        )
 
     def _create_vertex(self, rnd: int) -> Vertex:
         """Vertex factory (Alg. 2 lines 17-21 + 29-31, quoted at
@@ -674,6 +690,23 @@ class Process:
             # fed (however slowly) is not partitioned
             self._stuck_steps = 0
             return
+        rx = self.metrics.counters.get("msgs_received", 0)
+        if rx != self._rx_at_patience:
+            # Traffic is still ARRIVING at this node: a driver pumping in
+            # chunks (mempool load drivers, WAN clocks) is throttling
+            # delivery below the offered load — throttled, not
+            # partitioned. HOLD the counter (don't accrue, don't reset):
+            # patience accrues only across steps where nothing reached us
+            # at all. Without this gate every chunk-limited pump cycle
+            # read as a stall, and once sync_patience elapsed all n nodes
+            # broadcast requests whose vertex re-serves amplify n^2 into
+            # a re-serve storm (the round-10 load drivers had to run with
+            # sync_patience=0 to avoid it). Receipts — not the shared
+            # broker's global queue length — are the signal a real
+            # deployment would have: a partitioned node sees silence and
+            # correctly keeps accruing toward a sync request.
+            self._rx_at_patience = rx
+            return
         self._stuck_steps += 1
         if self._stuck_steps < self.cfg.sync_patience:
             return
@@ -716,15 +749,34 @@ class Process:
         self._sync_last_lo = lo
         self.metrics.inc("sync_requested")
         self.log.event("sync_request", lo=lo, hi=hi)
-        self.transport.broadcast(
-            BroadcastMessage(
-                vertex=None,
-                round=lo,
-                sender=self.index,
-                kind="sync",
-                origin=hi,
-            )
+        req = BroadcastMessage(
+            vertex=None,
+            round=lo,
+            sender=self.index,
+            kind="sync",
+            origin=hi,
         )
+        # Anti-entropy is PULL gossip: ask ONE peer per patience window,
+        # rotating deterministically, instead of broadcasting the
+        # request to all n-1. A broadcast request makes every peer
+        # answer with the full window — n responders x window x n
+        # destinations amplified one stuck round into ~n^2 duplicate
+        # traffic at n=32 (the re-serve storm). Rotation reaches an
+        # honest, connected peer within f+1 windows; if the stack has
+        # no unicast seam (or the chosen peer is gone) the request
+        # degrades to the old broadcast.
+        send = resolve_unicast(self.transport)
+        if send is not None:
+            peer = self._sync_peer_rr % self.cfg.n
+            if peer == self.index:
+                peer = (peer + 1) % self.cfg.n
+            self._sync_peer_rr = peer + 1
+            try:
+                send(peer, req)
+                return
+            except KeyError:
+                pass  # peer not subscribed (down/late): fall back
+        self.transport.broadcast(req)
 
     def _on_sync_nack(self, msg: BroadcastMessage) -> None:
         """A responder's "your window is below my GC floor" signal.
@@ -833,12 +885,33 @@ class Process:
                 )
             )
             return
+        # Serve UNICAST to the requester when the stack has a
+        # per-destination seam: a broadcast response multiplies every
+        # served vertex by n-1 destinations, and with many peers
+        # answering the same request the re-serve traffic amplifies
+        # ~n^2 — at n=32 one patience round buried live VALs behind
+        # ~300k stale duplicates and wedged the cluster. Under Bracha
+        # (requires_broadcast) the seam resolves to None and responses
+        # stay broadcast: peers must see repeat VALs to refresh READYs
+        # or the requester can never reach delivery quorum.
+        send = resolve_unicast(self.transport)
         count = 0
         for r in range(lo, hi + 1):
             for v in self.dag.vertices_in_round(r):
-                self.transport.broadcast(
-                    BroadcastMessage(vertex=v, round=v.round, sender=v.source)
+                out = BroadcastMessage(
+                    vertex=v, round=v.round, sender=v.source
                 )
+                if send is not None:
+                    try:
+                        send(msg.sender, out)
+                    except KeyError:
+                        # requester has no inbox on this broker (left,
+                        # or never subscribed): degrade to broadcast
+                        # for the rest of the window
+                        send = None
+                        self.transport.broadcast(out)
+                else:
+                    self.transport.broadcast(out)
                 count += 1
         if count:
             self.metrics.inc("sync_served", count)

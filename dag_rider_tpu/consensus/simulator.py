@@ -36,6 +36,7 @@ class Simulation:
         verifier_factory: Optional[Callable[[int], object]] = None,
         signer_factory: Optional[Callable[[int], object]] = None,
         rbc: bool = False,
+        process_factory: Optional[Callable[..., Process]] = None,
         log=None,
     ) -> None:
         self.cfg = cfg
@@ -58,6 +59,10 @@ class Simulation:
         #: dispatched, the pre-round-5 behavior (kept for A/B tests)
         self.dedup = True
         self.processes: List[Process] = []
+        # Per-index process constructor seam: the Byzantine scenario suite
+        # (consensus/adversary.py) substitutes ByzantineProcess for the
+        # faulty indices; same signature as Process.
+        mk = process_factory if process_factory is not None else Process
         for i in range(cfg.n):
             sink = self.deliveries[i]
             tp: Transport = self.transport
@@ -69,7 +74,7 @@ class Simulation:
 
                 tp = RbcTransport(self.transport, i, cfg.n, cfg.f)
             self.processes.append(
-                Process(
+                mk(
                     cfg,
                     i,
                     tp,
@@ -436,26 +441,37 @@ class Simulation:
         mutated copies fail verification at honest nodes instead, and
         the full check passes — see test_full_stack). Default compares
         everyone, which is the right check whenever no process is
-        deliberately faulty."""
+        deliberately faulty.
+
+        Delegates to the reusable checker in consensus/invariants.py
+        (raises InvariantViolation, an AssertionError subclass)."""
+        from dag_rider_tpu.consensus.invariants import (
+            check_agreement,
+            delivery_records,
+        )
+
         excluded = set(exclude)
-        idxs = [i for i in range(self.cfg.n) if i not in excluded]
         logs = {
-            i: [
-                (v.id.round, v.id.source, v.digest())
-                for v in self.deliveries[i]
-            ]
-            for i in idxs
+            i: delivery_records(self.deliveries[i])
+            for i in range(self.cfg.n)
+            if i not in excluded
         }
-        for ai, i in enumerate(idxs):
-            for j in idxs[ai + 1 :]:
-                a, b = logs[i], logs[j]
-                k = min(len(a), len(b))
-                if a[:k] != b[:k]:
-                    diverge = next(x for x in range(k) if a[x] != b[x])
-                    raise AssertionError(
-                        f"order divergence between p{i} and p{j} at "
-                        f"position {diverge}: {a[diverge]} vs {b[diverge]}"
-                    )
+        check_agreement(logs)
+
+    def attach_invariant_monitor(self, exclude: tuple = ()):
+        """Online safety assertions (consensus/invariants.py): wrap every
+        non-excluded process's a_deliver callback in an InvariantMonitor
+        so agreement / commit-uniqueness violations raise at the exact
+        delivery that breaks them, not in a post-run audit. Attach BEFORE
+        running; returns the monitor."""
+        from dag_rider_tpu.consensus.invariants import InvariantMonitor
+
+        mon = InvariantMonitor(self.cfg.n, exclude=exclude)
+        for p in self.processes:
+            if p.index in mon.exclude:
+                continue
+            p.on_deliver = mon.wrap(p.index, p.on_deliver)
+        return mon
 
 
 class RandomizedScheduler:

@@ -123,13 +123,16 @@ class ClusterLoadDriver:
     ``wall=True``: real time — used by the bench rung so the latency
     histogram measures what a client would see.
 
-    Build the Simulation with ``sync_patience=0``: the driver's chunked
-    pumping deliberately throttles delivery below the offered load, and
-    the anti-entropy machinery reads that backlog (queued client blocks
-    + quorum-incomplete rounds) as a partition — every process then
-    broadcasts sync requests whose vertex re-serves amplify n^2 into a
-    multi-million-message storm. Its wall-clock request cooldown would
-    also leak wall-time nondeterminism into virtual-clock runs.
+    The driver's chunked pumping deliberately throttles delivery below
+    the offered load; sync patience is backlog-aware (a process with
+    undelivered transport backlog is throttled, not partitioned —
+    Process._maybe_request_sync), so the anti-entropy machinery no
+    longer mistakes the throttle for a partition and the round-10
+    ``sync_patience=0`` workaround is gone: a genuinely dark peer under
+    this driver still gets anti-entropy recovery. Virtual-clock runs
+    that must replay byte-identically across wall time should still pin
+    ``sync_request_cooldown_s``/``sync_serve_cooldown_s`` (they are
+    wall-clock rate limits) or sync_patience itself.
     """
 
     def __init__(
@@ -370,7 +373,6 @@ def smoke(
         coin="round_robin",
         propose_empty=True,
         gc_depth=24,
-        sync_patience=0,  # see ClusterLoadDriver docstring
     )
     sim = Simulation(cfg)
     gen = LoadGenerator(

@@ -17,11 +17,39 @@ multi-host deployments (:mod:`dag_rider_tpu.transport.net`).
 from __future__ import annotations
 
 import abc
-from typing import Callable
+from typing import Callable, Optional
 
 from dag_rider_tpu.core.types import BroadcastMessage
 
 Handler = Callable[[BroadcastMessage], None]
+
+
+def resolve_unicast(transport) -> Optional[Callable]:
+    """Find a per-destination send seam for PROTOCOL traffic: unwrap
+    ``.inner`` chains until something exposes ``enqueue(dest, msg)``
+    (InMemoryTransport does; FaultyTransport composes through it —
+    handlers registered with the inner broker are the fault-wrapped
+    ones, so unicast sends still pay delivery-time fault rolls).
+
+    Stops with None at any layer that declares ``requires_broadcast``
+    (RbcTransport): Bracha's totality/catch-up depends on every peer
+    seeing repeat VALs, so honest senders must not tunnel past it.
+    (The Byzantine adversary seam in consensus/adversary.py unwraps
+    unconditionally — NOT honoring the contract is the attack.)
+
+    Returns None when the stack has no such seam; callers degrade to
+    broadcast."""
+    seen: set = set()
+    tp = transport
+    while tp is not None and id(tp) not in seen:
+        seen.add(id(tp))
+        if getattr(tp, "requires_broadcast", False):
+            return None
+        fn = getattr(tp, "enqueue", None)
+        if callable(fn):
+            return fn
+        tp = getattr(tp, "inner", None)
+    return None
 
 
 class Transport(abc.ABC):

@@ -21,13 +21,24 @@ false). For the in-memory default the observable schedule is unchanged:
 FIFO delivery order equals enqueue order equals broadcast order, so the
 seeded roll sequence — and therefore every seed-pinned chaos test —
 is identical to the old broadcast-time injection.
+
+WAN mode (round 11): pass ``topology=`` a :class:`WanTopology` and the
+single uniform roll is replaced by per-link (src, dst) behavior — an RTT
+matrix with jitter, per-link drop/duplicate overrides, and scheduled
+:class:`Partition`\\ s that *heal*: traffic across a severed cut is held
+(not lost) and released once the partition ends. The wrapper gains a
+virtual clock — drive it with :meth:`advance`; ``flush_delayed`` still
+means "eventual delivery NOW" and drains everything held. The legacy
+single-roll path is untouched when no topology is given, so the seeded
+roll sequence of existing chaos tests stays byte-identical.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import random
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dag_rider_tpu.core.types import BroadcastMessage, Vertex
 from dag_rider_tpu.transport.base import Handler, Transport
@@ -46,19 +57,134 @@ class FaultPlan:
     seed: int = 0
 
 
+@dataclasses.dataclass(frozen=True)
+class LinkPlan:
+    """Per-(src, dst) link behavior for WAN mode. ``rtt_s`` is the round
+    trip; one-way latency is ``rtt_s / 2`` plus uniform jitter in
+    ``[0, jitter_s)``. ``drop``/``duplicate`` are per-delivery
+    probabilities on this link."""
+
+    rtt_s: float = 0.0
+    jitter_s: float = 0.0
+    drop: float = 0.0
+    duplicate: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """A scheduled cut that heals: while ``start_s <= now < heal_s``,
+    traffic between different ``groups`` is held and released at
+    ``heal_s`` (asynchrony: delayed, never lost). Nodes absent from
+    every group are unaffected."""
+
+    start_s: float
+    heal_s: float
+    groups: Tuple[Tuple[int, ...], ...]
+
+    def active(self, now: float) -> bool:
+        return self.start_s <= now < self.heal_s
+
+    def severed(self, src: int, dst: int) -> bool:
+        gs = gd = None
+        for gi, members in enumerate(self.groups):
+            if src in members:
+                gs = gi
+            if dst in members:
+                gd = gi
+        return gs is not None and gd is not None and gs != gd
+
+
+class WanTopology:
+    """Per-link plans + partition schedule for FaultyTransport WAN mode.
+
+    Resolution order for ``link(src, dst)``: an explicit ``links``
+    override, else the intra/inter-region pair when built via
+    :meth:`regions`, else ``default``.
+    """
+
+    def __init__(
+        self,
+        default: LinkPlan = LinkPlan(),
+        links: Optional[Dict[Tuple[int, int], LinkPlan]] = None,
+        partitions: Tuple[Partition, ...] = (),
+    ) -> None:
+        self.default = default
+        self.links: Dict[Tuple[int, int], LinkPlan] = dict(links or {})
+        self.partitions = tuple(partitions)
+        self._region: Optional[List[int]] = None
+        self._inter: Optional[LinkPlan] = None
+
+    @classmethod
+    def regions(
+        cls,
+        n: int,
+        k: int = 2,
+        *,
+        intra: LinkPlan = LinkPlan(rtt_s=0.002),
+        inter: LinkPlan = LinkPlan(rtt_s=0.04, jitter_s=0.01),
+        partitions: Tuple[Partition, ...] = (),
+    ) -> "WanTopology":
+        """Round-robin region assignment (node i -> region i % k): cheap
+        intra-region links, expensive inter-region ones — the classic
+        geo-replicated shape."""
+        topo = cls(default=intra, partitions=partitions)
+        topo._region = [i % k for i in range(n)]
+        topo._inter = inter
+        return topo
+
+    def link(self, src: int, dst: int) -> LinkPlan:
+        lp = self.links.get((src, dst))
+        if lp is not None:
+            return lp
+        if self._region is not None:
+            r = self._region
+            if (
+                0 <= src < len(r)
+                and 0 <= dst < len(r)
+                and r[src] != r[dst]
+            ):
+                return self._inter or self.default
+        return self.default
+
+    def heal_time(self, src: int, dst: int, now: float) -> Optional[float]:
+        """Latest heal time of any partition currently severing
+        (src, dst), or None if the pair is connected at ``now``."""
+        t: Optional[float] = None
+        for p in self.partitions:
+            if p.active(now) and p.severed(src, dst):
+                t = p.heal_s if t is None else max(t, p.heal_s)
+        return t
+
+
 class FaultyTransport(Transport):
     """Wraps any Transport (in-memory by default), applying a FaultPlan
-    to each delivery."""
+    to each delivery — and, with ``topology=``, per-link WAN behavior."""
 
-    def __init__(self, plan: FaultPlan, inner: Optional[Transport] = None):
+    def __init__(
+        self,
+        plan: FaultPlan,
+        inner: Optional[Transport] = None,
+        topology: Optional[WanTopology] = None,
+    ):
         self.inner: Transport = (
             inner if inner is not None else InMemoryTransport()
         )
         self.plan = plan
+        self.topology = topology
         self.rng = random.Random(plan.seed)
         #: (dest, real handler, message) held back by a delay roll
         self.delayed: List[tuple] = []
         self.stats = {"dropped": 0, "delayed": 0, "duplicated": 0, "equivocated": 0}
+        if topology is not None:
+            # WAN gauges only exist in WAN mode: chaos tests snapshot the
+            # legacy stats dict and its key set must not change under them
+            self.stats["held_link"] = 0
+            self.stats["held_partition"] = 0
+        #: virtual clock + in-flight heap for WAN mode:
+        #: (release time, seq, dest, handler, msg)
+        self.now = 0.0
+        self._held: List[tuple] = []
+        self._seq = 0
         self._handlers: Dict[int, Handler] = {}
         self._mutator: Optional[Callable[[Vertex], Vertex]] = None
 
@@ -81,7 +207,8 @@ class FaultyTransport(Transport):
         """One (message, destination) delivery through the plan. The
         roll structure per delivery — optional equivocation coin, one
         main drop/delay roll, a duplicate roll only when delivered — is
-        the original broadcast-time sequence verbatim."""
+        the original broadcast-time sequence verbatim (WAN mode takes
+        its own per-link branch instead of the single uniform roll)."""
         out = msg
         if (
             msg.kind == "val"
@@ -91,6 +218,9 @@ class FaultyTransport(Transport):
         ):
             out = dataclasses.replace(msg, vertex=self._equivocate(msg.vertex))
             self.stats["equivocated"] += 1
+        if self.topology is not None:
+            self._deliver_wan(dest, handler, out)
+            return
         roll = self.rng.random()
         if roll < self.plan.drop:
             self.stats["dropped"] += 1
@@ -103,6 +233,56 @@ class FaultyTransport(Transport):
         if self.rng.random() < self.plan.duplicate:
             self.stats["duplicated"] += 1
             handler(out)
+
+    def _deliver_wan(
+        self, dest: int, handler: Handler, msg: BroadcastMessage
+    ) -> None:
+        """Per-link delivery: roll the LINK's drop, schedule at the
+        link's one-way latency (+jitter), and hold severed traffic until
+        the partition heals. Held messages release in timestamp order
+        via :meth:`advance` (or all at once via flush_delayed)."""
+        link = self.topology.link(msg.sender, dest)
+        if link.drop and self.rng.random() < link.drop:
+            self.stats["dropped"] += 1
+            return
+        latency = link.rtt_s / 2.0
+        if link.jitter_s:
+            latency += self.rng.uniform(0.0, link.jitter_s)
+        release = self.now + latency
+        heal = self.topology.heal_time(msg.sender, dest, self.now)
+        if heal is not None:
+            release = max(release, heal)
+            self.stats["held_partition"] += 1
+        copies = 1
+        if link.duplicate and self.rng.random() < link.duplicate:
+            self.stats["duplicated"] += 1
+            copies = 2
+        for _ in range(copies):
+            if release <= self.now:
+                handler(msg)
+            else:
+                if heal is None:
+                    self.stats["held_link"] += 1
+                heapq.heappush(
+                    self._held, (release, self._seq, dest, handler, msg)
+                )
+                self._seq += 1
+
+    def advance(self, dt: float) -> int:
+        """Move the WAN virtual clock forward and deliver every held
+        message that comes due, in release order. Returns deliveries.
+        No-op without a topology (the legacy delayed list is released
+        explicitly via flush_delayed, not by time)."""
+        self.now += dt
+        return self._release_due()
+
+    def _release_due(self) -> int:
+        done = 0
+        while self._held and self._held[0][0] <= self.now:
+            _, _, _dest, handler, msg = heapq.heappop(self._held)
+            handler(msg)
+            done += 1
+        return done
 
     def _equivocate(self, v: Vertex) -> Vertex:
         if self._mutator is not None:
@@ -117,11 +297,17 @@ class FaultyTransport(Transport):
         """Deliver all held-back messages (asynchrony: every message is
         eventually delivered). Straight to the captured real handlers —
         a delayed message already paid its fault rolls and must not roll
-        again on the way out."""
+        again on the way out. In WAN mode this also fast-forwards the
+        clock past every in-flight release (including partition holds) —
+        the "eventually" drain; use :meth:`advance` for paced release."""
         held, self.delayed = self.delayed, []
         for _dest, handler, msg in held:
             handler(msg)
-        return len(held)
+        count = len(held)
+        if self._held:
+            self.now = max(self.now, max(r for r, *_ in self._held))
+            count += self._release_due()
+        return count
 
     # pump passthrough so Simulation can drive us; inners without a pump
     # loop (push-style transports deliver inside broadcast) have nothing
@@ -136,4 +322,12 @@ class FaultyTransport(Transport):
 
     @property
     def pending(self) -> int:
-        return int(getattr(self.inner, "pending", 0))
+        """Undelivered backlog: the inner queue plus everything this
+        wrapper is holding (delay rolls, WAN in-flight/partition holds).
+        Held messages ARE pending deliveries — sync patience reads this
+        to tell "throttled" from "partitioned"."""
+        return (
+            int(getattr(self.inner, "pending", 0))
+            + len(self.delayed)
+            + len(self._held)
+        )

@@ -45,6 +45,11 @@ Slot = Tuple[int, int]  # (round, source) — one broadcast instance
 class RbcTransport(Transport):
     """Per-process Bracha reliable-broadcast stage."""
 
+    #: honest senders must not tunnel unicast past this stage: totality
+    #: and decided-slot catch-up both hinge on peers seeing repeat VALs
+    #: (ready refresh) — see transport.base.resolve_unicast
+    requires_broadcast = True
+
     def __init__(self, inner: Transport, index: int, n: int, f: int):
         self.inner = inner
         self.index = index
@@ -132,6 +137,12 @@ class RbcTransport(Transport):
         self.inner.broadcast(msg)
         if msg.kind == "val" and msg.vertex is not None:
             self._on_val(msg)
+
+    @property
+    def pending(self) -> int:
+        """Inner-broker backlog passthrough — sync patience reads this
+        to tell a throttled pump from a real partition."""
+        return int(getattr(self.inner, "pending", 0))
 
     # -- protocol -----------------------------------------------------------
 

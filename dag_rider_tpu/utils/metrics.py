@@ -254,6 +254,12 @@ class Metrics:
 
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = dict(self.counters)
+        # Byzantine-detection counter is part of the stable snapshot/rung
+        # schema even when zero (defaultdict counters only appear once
+        # incremented): chaos and adversary rungs assert DETECTION
+        # counts next to the transport_* fault stats, and "0 detected"
+        # must be distinguishable from "not surfaced".
+        out.setdefault("equivocations_detected", 0)
         if self.verify_batch_sizes:
             out["verify_sigs_total"] = self.verify_sigs_total
             out["verify_sigs_per_sec"] = self.sigs_per_sec()

@@ -307,11 +307,14 @@ def test_faulty_transport_wraps_generic_transport():
     # delay=1.0: both deliveries held, none dropped or duplicated
     assert got[1] == [] and got[2] == []
     assert tp.stats["delayed"] == 2 and tp.stats["dropped"] == 0
-    # push-style inner: nothing to pump, nothing pending
-    assert tp.pump_one() is False and tp.pump() == 0 and tp.pending == 0
+    # push-style inner: nothing to pump, but the two held messages ARE
+    # pending deliveries (round 11: sync patience reads this gauge to
+    # tell "throttled" from "partitioned")
+    assert tp.pump_one() is False and tp.pump() == 0 and tp.pending == 2
     # flush reaches the real handlers; delay=1.0 would hold them forever
     # if the flush re-rolled the plan
     assert tp.flush_delayed() == 2
+    assert tp.pending == 0
     assert len(got[1]) == 1 and len(got[2]) == 1
     assert got[1][0].vertex == v
 

@@ -39,9 +39,12 @@ SIM_CFG = dict(
     coin="round_robin",
     propose_empty=True,
     gc_depth=24,
-    # the driver's chunked pumping reads as a partition to anti-entropy
-    # (see ClusterLoadDriver docstring)
-    sync_patience=0,
+    # default sync_patience: the backlog-aware gate in
+    # Process._maybe_request_sync keeps the driver's chunked pumping
+    # from reading as a partition. Cooldowns are wall-clock rate limits;
+    # zeroing them keeps the replay/determinism tests wall-time-free.
+    sync_request_cooldown_s=0.0,
+    sync_serve_cooldown_s=0.0,
 )
 
 

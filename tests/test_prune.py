@@ -99,7 +99,9 @@ def test_pruned_node_serves_sync_within_window_refuses_below():
     base = p.dag.base_round
     assert base > 1
     outbox = []
-    p.transport.broadcast = lambda msg: outbox.append(msg)  # capture serves
+    # nacks broadcast; window serves unicast to the requester (round 11)
+    p.transport.broadcast = lambda msg: outbox.append(msg)
+    p.transport.enqueue = lambda dest, msg: outbox.append(msg)
 
     # request below the horizon -> clean refusal: no vertices served,
     # just the sync_nack that steers the requester to state transfer

@@ -354,9 +354,15 @@ def test_attested_peer_floor_unwedges_blocked_buffer():
     p.step()
     assert {v_low.id, v_strong.id, v_weak.id} <= p._buffered_ids
 
-    # stuck -> sync request fires at lo = min blocker round (5)
+    # stuck -> sync request fires at lo = min blocker round (5).
+    # Requests are unicast (pull gossip, round 11): capture both seams,
+    # and settle the receipt watermark — the backlog-aware patience gate
+    # holds while receipts are still arriving, and the on_message calls
+    # above count as receipts.
     outbox = []
     p.transport.broadcast = lambda m: outbox.append(m)
+    p.transport.enqueue = lambda dest, m: outbox.append(m)
+    p._rx_at_patience = p.metrics.counters.get("msgs_received", 0)
     p._maybe_request_sync()
     reqs = [m for m in outbox if m.kind == "sync"]
     assert reqs and reqs[0].round == 5
@@ -381,6 +387,7 @@ def test_attested_peer_floor_unwedges_blocked_buffer():
     outbox.clear()
     p._sync_last_request = float("-inf")  # cooldown passed
     p._stuck_steps = 10**6
+    p._rx_at_patience = p.metrics.counters.get("msgs_received", 0)
     p._maybe_request_sync()
     reqs = [m for m in outbox if m.kind == "sync"]
     assert reqs == [] or reqs[0].round > 8

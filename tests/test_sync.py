@@ -225,3 +225,39 @@ def test_idle_node_with_future_buffer_does_not_spam_sync():
             p.step()
     assert procs[3].round == 0 and procs[3].buffer  # future vertices held
     assert procs[3].metrics.counters.get("sync_requested", 0) == 0
+
+
+def test_throttled_pump_does_not_trigger_sync_storm():
+    """Regression (round 11): a chunk-limited pump delivers below the
+    offered load, so every process sits with queued client blocks and an
+    incomplete current round — the exact "waiting" shape that used to
+    read as a partition once sync_patience elapsed, at which point all n
+    processes broadcast requests whose vertex re-serves amplified n^2
+    into a re-serve storm (the round-10 load drivers pinned
+    sync_patience=0 to dodge it). The backlog-aware gate in
+    Process._maybe_request_sync must recognize undelivered transport
+    backlog as "throttled, not partitioned": zero sync requests, clean
+    agreement, normal progress — with a hair-trigger patience."""
+    from dag_rider_tpu.consensus.simulator import Simulation
+
+    cfg = Config(
+        n=4,
+        coin="round_robin",
+        propose_empty=True,
+        sync_patience=4,  # tighter than the default 8: the gate does the work
+        sync_request_cooldown_s=0.0,
+        sync_serve_cooldown_s=0.0,
+    )
+    sim = Simulation(cfg)
+    sim.submit_blocks(16)
+    for _ in range(150):
+        sim.run(max_messages=3)  # starvation-level throttle (< one round)
+    assert (
+        sum(
+            p.metrics.counters.get("sync_requested", 0)
+            for p in sim.processes
+        )
+        == 0
+    )
+    sim.check_agreement()
+    assert max(p.round for p in sim.processes) >= 5
