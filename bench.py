@@ -368,6 +368,15 @@ def _sim_rung(
         "poisoned_windows": rs.get("poisoned_windows", 0),
         "sidecar_rpc_failures": rs.get("sidecar_rpc_failures", 0),
     }
+    # round-12 host-pump gauges: which pump flavor drove the run and
+    # what the host paid per round at the consensus seam (the quantity
+    # the vectorized pump exists to move)
+    snap0 = sim.processes[0].metrics.snapshot()
+    pump_gauges = {
+        k: snap0[k]
+        for k in ("pump_path", "pump_msgs_per_s", "host_pump_ms_per_round")
+        if k in snap0
+    }
     return {
         "nodes": n,
         "coin": entry_coin,
@@ -457,8 +466,84 @@ def _sim_rung(
             **prep_gauges,
             # fault-containment / degradation-ladder gauges (round 9)
             **res_gauges,
+            # host consensus-pump gauges (round 12)
+            **pump_gauges,
         },
     }
+
+
+def _vec_ab_rung(n: int, budget_s: float, target_round: int) -> dict:
+    """Scalar-vs-vector host pump A/B (round 12). Two null-verifier sims
+    run the SAME protocol to the same target round, one per pump flavor;
+    the vector path must produce byte-identical per-view delivery
+    sequences (id + digest) — it is an execution strategy, not a
+    protocol change — and the msgs/s ratio is the rung's headline.
+    Raises AssertionError on commit-order divergence. Also the tier1-vec
+    CI smoke (tests/test_bench_rungs.py)."""
+    import time as _t
+
+    from dag_rider_tpu.config import Config
+    from dag_rider_tpu.consensus.simulator import Simulation
+
+    sides: dict = {}
+    orders: dict = {}
+    for path in ("scalar", "vector"):
+        cfg = Config(
+            n=n,
+            coin="round_robin",
+            propose_empty=True,
+            gc_depth=24,
+            pump=path,
+        )
+        sim = Simulation(cfg)
+        sim.submit_blocks(per_process=2)
+        t0 = _t.monotonic()
+        pumped = 0
+        while (
+            max(p.round for p in sim.processes) < target_round
+            and _t.monotonic() - t0 < budget_s
+        ):
+            pumped += sim.run(max_messages=n * (n - 1))
+        dt = _t.monotonic() - t0
+        sim.check_agreement()
+        snap0 = sim.processes[0].metrics.snapshot()
+        orders[path] = [
+            [(v.id, v.digest()) for v in d] for d in sim.deliveries
+        ]
+        sides[path] = {
+            "seconds": round(dt, 2),
+            "messages": pumped,
+            "msgs_per_sec": round(pumped / dt, 1),
+            "max_round": max(p.round for p in sim.processes),
+            "vertices_delivered_total": sum(
+                len(d) for d in sim.deliveries
+            ),
+            **{
+                k: snap0[k]
+                for k in ("pump_msgs_per_s", "host_pump_ms_per_round")
+                if k in snap0
+            },
+        }
+    identical = orders["scalar"] == orders["vector"]
+    entry = {
+        "nodes": n,
+        "target_round": target_round,
+        "scalar": sides["scalar"],
+        "vector": sides["vector"],
+        # the equivalence gate: same deliveries, same order, same
+        # bytes, at every view
+        "commit_order_identical": identical,
+        "speedup": round(
+            sides["vector"]["msgs_per_sec"]
+            / max(sides["scalar"]["msgs_per_sec"], 1e-9),
+            2,
+        ),
+    }
+    if not identical:
+        raise AssertionError(
+            f"sim{n}_vec: vector pump diverged from scalar commit order"
+        )
+    return entry
 
 
 def _measure() -> None:
@@ -886,13 +971,20 @@ def _measure() -> None:
     # covered by sim64/sim256; the CPU fallback sets
     # DAGRIDER_BENCH_HOSTSIM_S so the official record still carries a
     # consensus number when the chip is unreachable.
-    def host_rung(n: int, secs: float) -> None:
-        tag = f"sim{n}_host"
+    def host_rung(n: int, secs: float, pump: str | None = None) -> None:
+        tag = f"sim{n}_host" + (f"_{pump}" if pump else "")
         _mark(f"ladder {tag}: {secs:.0f}s null-verifier consensus")
         from dag_rider_tpu.config import Config
         from dag_rider_tpu.consensus.simulator import Simulation
 
-        cfg = Config(n=n, coin="round_robin", propose_empty=True, gc_depth=24)
+        cfg = Config(
+            n=n,
+            coin="round_robin",
+            propose_empty=True,
+            gc_depth=24,
+            # None defers to DAGRIDER_PUMP / scalar (Config default)
+            pump=pump,
+        )
         sim = Simulation(cfg)
         sim.submit_blocks(per_process=2)
         t0 = time.monotonic()
@@ -901,9 +993,11 @@ def _measure() -> None:
             pumped += sim.run(max_messages=n * (n - 1))
         dt = time.monotonic() - t0
         sim.check_agreement()
+        snap0 = sim.processes[0].metrics.snapshot()
         result["ladder"][tag] = {
             "nodes": n,
             "verifier": "none",
+            "pump": sim.processes[0].cfg.pump,
             "seconds": round(dt, 1),
             "messages": pumped,
             "msgs_per_sec": round(pumped / dt, 1),
@@ -915,6 +1009,17 @@ def _measure() -> None:
                 len(p.dag.vertices) for p in sim.processes
             ),
             "agreement": True,
+            # host-pump accounting (round 12): ms of pump+step per
+            # round advanced, and delivered msgs per pump-wall second
+            **{
+                k: snap0[k]
+                for k in (
+                    "pump_path",
+                    "pump_msgs_per_s",
+                    "host_pump_ms_per_round",
+                )
+                if k in snap0
+            },
         }
         host_ivals = sorted(
             s
@@ -943,6 +1048,26 @@ def _measure() -> None:
     hostsim256_s = float(os.environ.get("DAGRIDER_BENCH_HOSTSIM256_S", "0"))
     if hostsim256_s > 0 and left() > hostsim256_s + 10:
         host_rung(256, hostsim256_s)
+
+    # -- ladder rung (round 12): scalar-vs-vector host pump A/B
+    # (bench._vec_ab_rung, the tier1-vec CI smoke). Off by default; a
+    # local capture sets DAGRIDER_BENCH_SIM256VEC_S high and _N=256 for
+    # the committee size.
+    vecab_s = float(os.environ.get("DAGRIDER_BENCH_SIM256VEC_S", "0"))
+    vecab_n = int(os.environ.get("DAGRIDER_BENCH_SIM256VEC_N", "256"))
+    vecab_round = int(os.environ.get("DAGRIDER_BENCH_SIM256VEC_ROUND", "12"))
+    if vecab_s > 0 and left() > 2 * vecab_s + 10:
+        tag = f"sim{vecab_n}_vec"
+        _mark(f"ladder {tag}: scalar-vs-vector A/B to round {vecab_round}")
+        entry = _vec_ab_rung(vecab_n, vecab_s, vecab_round)
+        result["ladder"][tag] = entry
+        _mark(
+            f"ladder {tag}: scalar "
+            f"{entry['scalar']['msgs_per_sec']:,.0f} msg/s vs vector "
+            f"{entry['vector']['msgs_per_sec']:,.0f} msg/s "
+            f"({entry['speedup']}x), commit order identical"
+        )
+        emit()
 
     # -- ladder rung #9 (round 10): mempool-fronted end-to-end commit
     # pipeline — client transactions through admission/batching/consensus

@@ -84,10 +84,26 @@ class Config:
     # each process retires DAG state below its decided frontier minus
     # gc_depth (DagState.prune_below), bounding memory for long runs.
     gc_depth: Optional[int] = None
+    # Host consensus pump path: "scalar" is the reference per-message /
+    # per-vertex semantics; "vector" is the round-batched refinement
+    # (byte-identical commit order — tests/test_pump_vector.py is the
+    # gate). None resolves from DAGRIDER_PUMP, defaulting to "scalar";
+    # an explicit value beats the environment.
+    pump: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
             raise ValueError(f"n must be >= 1, got {self.n}")
+        if self.pump is None:
+            object.__setattr__(
+                self,
+                "pump",
+                os.environ.get("DAGRIDER_PUMP", "").strip() or "scalar",
+            )
+        if self.pump not in ("scalar", "vector"):
+            raise ValueError(
+                f'pump must be "scalar" or "vector", got {self.pump!r}'
+            )
         if self.f is None:
             object.__setattr__(self, "f", (self.n - 1) // 3)
         if self.n < 3 * self.f + 1:

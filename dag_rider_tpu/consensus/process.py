@@ -90,7 +90,24 @@ class Process:
             self.dag.insert(Vertex(id=VertexID(0, i)))
 
         self.round = 0
-        self.buffer: List[Vertex] = []
+        #: round-batched pump (cfg.pump == "vector" / DAGRIDER_PUMP):
+        #: VAL admission checks run batched at the top of :meth:`step`
+        #: (_process_inbox) and the buffer drains whole round groups
+        #: against the dense mirrors (_drain_buffer_vector). Scalar mode
+        #: is the reference oracle; byte-identical commit order is the
+        #: gate (tests/test_pump_vector.py).
+        self._vector = cfg.pump == "vector"
+        #: deferred VAL messages awaiting _process_inbox (vector mode
+        #: only; control messages are never deferred).
+        self._inbox: List[BroadcastMessage] = []
+        self._buffer: List[Vertex] = []
+        #: vector-mode buffer storage: round -> {vid: vertex} in arrival
+        #: order (dicts preserve insertion order; the vid key doubles as
+        #: the duplicate-membership probe, replacing the per-message
+        #: _buffered_ids add/discard churn of the scalar path).
+        self._buffer_rounds: Dict[int, Dict[VertexID, Vertex]] = {}
+        #: scalar-mode buffer membership mirror; vector mode keys the
+        #: round groups by vid instead and leaves this set empty.
         self._buffered_ids: Set[VertexID] = set()
         #: blocked-vertex memo for _drain_buffer's short-circuit; entries
         #: live exactly as long as the vertex sits in the buffer.
@@ -184,6 +201,33 @@ class Process:
             "threshold_bls coin must be constructed explicitly with keys"
         )
 
+    @property
+    def buffer(self) -> List[Vertex]:
+        """Buffered vertices awaiting predecessors.
+
+        Scalar mode stores a flat arrival-order list; vector mode stores
+        per-round groups (the drain key) and flattens on demand —
+        round-ascending, arrival order within a round — for external
+        readers (checkpoint save, sync targeting, tests). The setter
+        accepts a flat list either way (checkpoint restore assigns one).
+        """
+        if self._vector:
+            out: List[Vertex] = []
+            for r in sorted(self._buffer_rounds):
+                out.extend(self._buffer_rounds[r].values())
+            return out
+        return self._buffer
+
+    @buffer.setter
+    def buffer(self, vs: List[Vertex]) -> None:
+        if self._vector:
+            groups: Dict[int, Dict[VertexID, Vertex]] = {}
+            for v in vs:
+                groups.setdefault(v.id.round, {})[v.id] = v
+            self._buffer_rounds = groups
+        else:
+            self._buffer = vs
+
     # ------------------------------------------------------------------
     # Client API (Algorithm 1 lines 1-4)
     # ------------------------------------------------------------------
@@ -215,16 +259,25 @@ class Process:
         influence any state.
         """
         self.metrics.inc("msgs_received")
-        if msg.kind == "sync":
-            self._serve_sync(msg)
-            return
-        if msg.kind == "sync_nack":
-            self._on_sync_nack(msg)
-            return
         if msg.kind != "val" or msg.vertex is None:
-            # RBC control traffic (echo/ready/fetch) is consumed by the
-            # transport/rbc.py stage; a Process only eats vertex payloads.
-            self.metrics.inc("msgs_ignored_kind")
+            self._on_control(msg)
+            return
+        if self._vector:
+            # Defer the admission checks to step(): nothing between
+            # delivery and the next step reads the state those checks
+            # write (the DAG only mutates inside step, and sync serving
+            # reads the DAG, not the inbox), so running them batched at
+            # the step boundary is observationally identical to running
+            # them here — in FIFO order either way.
+            self._inbox.append(msg)
+            if not self.defer_steps:
+                if self._started:
+                    self.step()
+                else:
+                    # not started: run the checks now (scalar counters
+                    # and pending/buffer state stay exactly in sync)
+                    # without stepping
+                    self._process_inbox()
             return
         v = msg.vertex
         if (
@@ -280,6 +333,156 @@ class Process:
         if self._started and not self.defer_steps:
             self.step()
 
+    def _on_control(self, msg: BroadcastMessage) -> None:
+        """Non-VAL dispatch, shared by both pump paths (the caller has
+        already counted msgs_received)."""
+        if msg.kind == "sync":
+            self._serve_sync(msg)
+        elif msg.kind == "sync_nack":
+            self._on_sync_nack(msg)
+        else:
+            # RBC control traffic (echo/ready/fetch) is consumed by the
+            # transport/rbc.py stage; a Process only eats vertex payloads.
+            self.metrics.inc("msgs_ignored_kind")
+
+    def on_messages(self, batch: List[BroadcastMessage]) -> None:
+        """Batch delivery entry (transport ``pump_grouped``): one call
+        per destination per pump chunk instead of one handler dispatch
+        per message. Scalar mode degrades to the per-message path;
+        vector mode queues VALs for the batched inbox checks and runs
+        ONE step for the whole batch."""
+        if not batch:
+            return
+        if not self._vector:
+            for m in batch:
+                self.on_message(m)
+            return
+        self.metrics.inc("msgs_received", len(batch))
+        inbox = self._inbox
+        for m in batch:
+            if m.kind != "val" or m.vertex is None:
+                # mixed batch (network codec frames): fall back to the
+                # per-message split so controls dispatch in position
+                for m2 in batch:
+                    if m2.kind == "val" and m2.vertex is not None:
+                        inbox.append(m2)
+                    else:
+                        self._on_control(m2)
+                break
+        else:
+            # pure VAL run — one C-level extend
+            inbox.extend(batch)
+        if not self.defer_steps:
+            if self._started:
+                self.step()
+            else:
+                self._process_inbox()
+
+    def on_val_batch(self, batch: List[BroadcastMessage]) -> None:
+        """Grouped-pump fast entry (vector mode): the broker guarantees
+        a pure VAL run (controls are delivered singly as barriers), so
+        the batch goes straight to the inbox with no per-message kind
+        scan. :meth:`on_messages` stays the kind-agnostic entry for
+        codec-decoded network frames."""
+        self.metrics.inc("msgs_received", len(batch))
+        self._inbox.extend(batch)
+        if not self.defer_steps:
+            if self._started:
+                self.step()
+            else:
+                self._process_inbox()
+
+    def _process_inbox(self) -> None:
+        """Run the deferred VAL admission checks (vector mode) — the
+        exact scalar on_message sequence per message, in FIFO order,
+        with the per-message constants hoisted and everything the
+        broadcast shares across the n-1 sibling processes memoized on
+        the message/vertex objects (stamp verdict, edge gate, digest).
+        The body is deliberately inline — at n=256 one round is ~65k
+        copies through this loop, and every helper call or re-probed
+        attribute showed up as ~0.5 us x 65k x rounds in the profile."""
+        inbox, self._inbox = self._inbox, []
+        n = self.cfg.n
+        gate_key = (n, self.cfg.quorum)
+        wave_len = self.cfg.wave_length
+        dag = self.dag
+        base = dag.base_round  # nothing in this loop prunes
+        vertices = dag.vertices
+        groups = self._buffer_rounds
+        pending = self._pending_verify_ids
+        seen = self._seen_digests
+        metrics_inc = self.metrics.inc
+        verifier = self.verifier
+        observe_share = self.coin.observe_share
+        last_r = -1  # round-group cache: batches arrive in same-round runs
+        grp: Optional[Dict[VertexID, Vertex]] = None
+        for msg in inbox:
+            v = msg.vertex
+            ok = msg.__dict__.get("_stamp_ok")
+            if ok is None or ok[0] != n:
+                ok = (
+                    n,
+                    v.id.round == msg.round
+                    and v.id.source == msg.sender
+                    and 0 <= v.id.source < n
+                    and v.id.round >= 1,
+                )
+                object.__setattr__(msg, "_stamp_ok", ok)
+            if not ok[1]:
+                metrics_inc("msgs_rejected_stamp")
+                self.log.event(
+                    "reject_stamp", round=msg.round, sender=msg.sender
+                )
+                continue
+            vid = v.id
+            r = vid.round
+            if r <= base:
+                metrics_inc("msgs_below_gc_horizon")
+                continue
+            if r != last_r:
+                last_r = r
+                grp = groups.get(r)
+            if (
+                vid in vertices
+                or (grp is not None and vid in grp)
+                or (pending and vid in pending)
+            ):
+                prev = seen.get(vid)
+                if prev is not None and prev != v.digest():
+                    metrics_inc("equivocations_detected")
+                    self.log.event(
+                        "equivocation", round=r, source=vid.source
+                    )
+                else:
+                    metrics_inc("msgs_duplicate")
+                continue
+            g = v.__dict__.get("_gate")
+            if g is not None and g[0] == gate_key:
+                valid = not g[1]
+            else:
+                valid = self.edges_valid(v)
+            if not valid:
+                metrics_inc("msgs_rejected_edges")
+                self.log.event(
+                    "reject_edges",
+                    round=r,
+                    source=vid.source,
+                    strong=len(v.strong_edges),
+                    weak=len(v.weak_edges),
+                )
+                continue
+            seen[vid] = v.__dict__.get("_digest") or v.digest()
+            if verifier is not None:
+                self._pending_verify.append(v)
+                pending.add(vid)
+            else:
+                if grp is None:
+                    grp = groups[r] = {}
+                grp[vid] = v
+                cs = v.coin_share
+                if cs is not None and r % wave_len == 0:
+                    observe_share(r // wave_len, vid.source, cs)
+
     def edges_valid(self, v: Vertex) -> bool:
         """The r_deliver admission gate: >= 2f+1 distinct strong edges
         (process.go:164-168), all targeting round-1, all sources in
@@ -313,9 +516,20 @@ class Process:
         return not bad_edges
 
     def _admit_to_buffer(self, v: Vertex) -> None:
-        self.buffer.append(v)
-        self._buffered_ids.add(v.id)
+        if self._vector:
+            self._buffer_rounds.setdefault(v.id.round, {})[v.id] = v
+        else:
+            self._buffer.append(v)
+            self._buffered_ids.add(v.id)
         self._observe_coin_share(v)
+
+    def _remove_from_buffer(self, vid: VertexID) -> None:
+        """Single site for buffer-exit bookkeeping: the id set and the
+        blocked-vertex memo must leave together, or a later drain pass
+        resurrects a stale short-circuit for a vertex that is long gone
+        (the storage list/group entry is dropped by the drain itself)."""
+        self._buffered_ids.discard(vid)
+        self._blocked_on.pop(vid, None)
 
     def _observe_coin_share(self, v: Vertex) -> None:
         if v.coin_share is not None and v.round % self.cfg.wave_length == 0:
@@ -376,6 +590,8 @@ class Process:
         progress = True
         while progress:
             progress = False
+            if self._inbox:
+                self._process_inbox()
             self._drain_verify()
             progress |= self._drain_buffer()
             progress |= self._try_advance()
@@ -390,6 +606,8 @@ class Process:
         A vertex from a future round stays buffered (``process.go:203-206``);
         repeated passes handle chains unlocked by an admission.
         """
+        if self._vector:
+            return self._drain_buffer_vector()
         admitted_any = False
         changed = True
         present = self.dag.present
@@ -408,7 +626,7 @@ class Process:
             # vectorized predecessor check over the whole buffer.
             cand: List[Vertex] = []
             cand_arrs = []
-            for v in self.buffer:
+            for v in self._buffer:
                 vid = v.id
                 if vid.round > self.round:
                     keep.append(v)
@@ -419,15 +637,13 @@ class Process:
                     # anywhere — unadmittable, drop it. (No re-pass: a
                     # drop adds nothing to the DAG, so it cannot unlock
                     # any other vertex's predecessor check.)
-                    self._buffered_ids.discard(vid)
-                    blocked.pop(vid, None)
+                    self._remove_from_buffer(vid)
                     self.metrics.inc("msgs_below_gc_horizon")
                     continue
                 if present(vid):
                     # raced in via another path; drop rather than
                     # re-insert (no re-pass — see above)
-                    self._buffered_ids.discard(vid)
-                    blocked.pop(vid, None)
+                    self._remove_from_buffer(vid)
                     self.metrics.inc("msgs_duplicate")
                     continue
                 bp = blocked.get(vid)
@@ -510,16 +726,157 @@ class Process:
                                 )
                                 keep.append(v)
                                 continue
-                    blocked.pop(v.id, None)
+                    self._remove_from_buffer(v.id)
                     self.dag.insert(v)
-                    self._buffered_ids.discard(v.id)
                     self.metrics.inc("vertices_admitted")
                     self.log.event(
                         "admit", round=v.round, source=v.source
                     )
                     changed = True
                     admitted_any = True
-            self.buffer = keep
+            self._buffer = keep
+        return admitted_any
+
+    def _drain_buffer_vector(self) -> bool:
+        """Round-batched buffer drain (the vector pump).
+
+        Edges only ever target LOWER rounds (strong: r-1, weak: < r-1 —
+        gate-enforced), so there are no intra-round dependencies and ONE
+        ascending sweep over the round groups reaches the same fixpoint
+        as the scalar while-changed loop: by the time round r is
+        checked, every admissible vertex below it has been admitted.
+        Per group the strong-predecessor check is one fancy index into a
+        SINGLE ``exists`` row + one segmented AND, and admissions land
+        as one :meth:`DagState.insert_many` batch. Admitted sets — and
+        hence everything downstream — are identical to scalar; only the
+        per-vertex bookkeeping is batched.
+        """
+        groups = self._buffer_rounds
+        if not groups:
+            return False
+        admitted_any = False
+        dag = self.dag
+        n = self.cfg.n
+        vertices = dag.vertices
+        metrics_inc = self.metrics.inc
+        log_on = self.log.enabled
+        for r in sorted(groups):
+            if r > self.round:
+                continue  # future round: stays buffered (process.go:203)
+            grp = groups.pop(r)
+            base = dag.base_round
+            if r <= base:
+                # Below the pruned floor: unadmittable everywhere — see
+                # the scalar pass-1 comment.
+                metrics_inc("msgs_below_gc_horizon", len(grp))
+                continue
+            exists_prev = dag.exists[r - 1 - base]
+            if len(grp) > 1 and exists_prev.all():
+                # Steady-state shape: round r-1 fully present, so every
+                # strong probe passes — ONE pass over the group fuses
+                # the duplicate filter with collecting the per-vertex
+                # flat strong-row indices (memoized cluster-wide on the
+                # shared vertex objects), and the whole batch lands as
+                # one 1-D scatter in insert_many. A weak edge (rare
+                # here: weak edges only exist for sources the proposer
+                # could NOT reach) bails to the general path below.
+                srcs: List[int] = []
+                flats: List[np.ndarray] = []
+                admit: List[Vertex] = []
+                sa, fa, aa = srcs.append, flats.append, admit.append
+                dups = 0
+                weak_seen = False
+                for v in grp.values():
+                    if v.id in vertices:
+                        dups += 1
+                        continue
+                    d = v.__dict__
+                    a = d.get("_edge_arrays") or v.edge_arrays()
+                    if a[2].size:
+                        weak_seen = True
+                        break
+                    s = v.id.source
+                    sa(s)
+                    aa(v)
+                    fs = d.get("_flat_strong")
+                    if fs is None or fs[0] != n:
+                        fs = (n, s * n + a[1])
+                        object.__setattr__(v, "_flat_strong", fs)
+                    fa(fs[1])
+                if not weak_seen:
+                    if dups:
+                        metrics_inc("msgs_duplicate", dups)
+                    if admit:
+                        dag.insert_many(
+                            admit, trusted=True, prepped=(srcs, flats)
+                        )
+                        metrics_inc("vertices_admitted", len(admit))
+                        if log_on:
+                            for v in admit:
+                                self.log.event(
+                                    "admit", round=v.round, source=v.source
+                                )
+                        admitted_any = True
+                    continue
+            live = [v for v in grp.values() if v.id not in vertices]
+            dups = len(grp) - len(live)
+            if dups:
+                metrics_inc("msgs_duplicate", dups)
+            if not live:
+                continue
+            arrs = [
+                v.__dict__.get("_edge_arrays") or v.edge_arrays()
+                for v in live
+            ]
+            if len(live) == 1:
+                ok = (True,) if exists_prev[arrs[0][1]].all() else (False,)
+            elif exists_prev.all():
+                # full presence but weak edges in the group: every
+                # strong probe passes; the loop below gates the weak
+                ok = (True,) * len(live)
+            else:
+                lens = np.fromiter(
+                    (a[1].size for a in arrs),
+                    dtype=np.intp,
+                    count=len(live),
+                )
+                hits = exists_prev[np.concatenate([a[1] for a in arrs])]
+                offs = np.zeros(len(live), dtype=np.intp)
+                np.cumsum(lens[:-1], out=offs[1:])
+                # >= quorum >= 1 strong edges each (gate-proved), so
+                # no zero-length segment
+                ok = np.bitwise_and.reduceat(hits, offs)
+            admit: List[Vertex] = []
+            keep: List[Vertex] = []
+            for i, v in enumerate(live):
+                if not ok[i]:
+                    keep.append(v)
+                    continue
+                wr, ws = arrs[i][2], arrs[i][3]
+                if wr.size:
+                    if base:
+                        # weak targets under the pruned floor are
+                        # finalized history — treated satisfied (scalar
+                        # pass-3 rule)
+                        w_live = wr > base
+                        wr, ws = wr[w_live], ws[w_live]
+                    if wr.size and not dag.exists[wr - base, ws].all():
+                        keep.append(v)
+                        continue
+                admit.append(v)
+            if admit:
+                # the drain already proved single-round grouping,
+                # non-presence and the edge gate — skip re-validation
+                dag.insert_many(admit, trusted=True)
+                metrics_inc("vertices_admitted", len(admit))
+                if log_on:
+                    for v in admit:
+                        self.log.event(
+                            "admit", round=v.round, source=v.source
+                        )
+                admitted_any = True
+            if keep:
+                groups[r] = {v.id: v for v in keep}
         return admitted_any
 
     def _try_advance(self) -> bool:
@@ -680,7 +1037,14 @@ class Process:
         # broadcasts were lost, so everyone's buffers can be EMPTY while
         # the cluster deadlocks; a quiescent cluster with no pending
         # blocks is *idle*, not stuck, and must not request forever).
-        waiting = bool(self.buffer) or (
+        # Scalar mirrors the buffer in _buffered_ids; vector keys the
+        # round-group dicts by vid instead — either emptiness check is
+        # O(1), unlike the ``buffer`` property which flattens groups.
+        waiting = (
+            bool(self._buffer_rounds)
+            if self._vector
+            else bool(self._buffered_ids)
+        ) or (
             bool(self.blocks_to_propose)
             and self.round >= 1
             and self.dag.round_size(self.round) < self.cfg.quorum
@@ -982,8 +1346,10 @@ class Process:
                 )
                 return
             prior = self._wave_leader(w)
-            if prior is not None and self.dag.path(
-                cur.id, prior.id, strong_only=True
+            if prior is not None and (
+                self._leader_path(cur.id, prior.id)
+                if self._vector
+                else self.dag.path(cur.id, prior.id, strong_only=True)
             ):
                 leaders.push(prior)
                 cur = prior
@@ -1091,6 +1457,28 @@ class Process:
         src = self.coin.choose_leader(wave)
         return self.dag.get(VertexID(self.cfg.wave_round(wave, 1), src))
 
+    def _leader_path(self, hi: VertexID, lo: VertexID) -> bool:
+        """Strong-path query for the retroactive leader chain (vector
+        pump): seeded vector @ matrix descent over the dense mirrors
+        (:func:`ops.dag_kernels.leader_reach_np`) — O(k·n²) bit ops for
+        a k-round gap instead of the scalar closure walk's per-round
+        Python bookkeeping. Same boolean-semiring reachability as
+        ``dag.path(strong_only=True)``; tests pin the twin against the
+        jitted kernel."""
+        from dag_rider_tpu.ops.dag_kernels import leader_reach_np
+
+        dag = self.dag
+        if not dag.present(hi) or not dag.present(lo):
+            return False
+        if hi == lo:
+            return True
+        if lo.round >= hi.round:
+            return False
+        vec = leader_reach_np(
+            dag.strong_stack(hi.round, lo.round), hi.source
+        )
+        return bool(vec[lo.source])
+
     def _strong_reach_count(self, r_hi: int, r_lo: int, leader_src: int) -> int:
         """|{v in dag[r_hi] : strong path v -> leader}| — host twin of
         ops.dag_kernels.wave_commit_votes.
@@ -1152,6 +1540,34 @@ class Process:
             if hi <= lo:
                 continue
             fresh = reached[lo:hi] & ~dmask[lo:hi]
+            if self._vector:
+                # Same slots in the same order (nonzero is row-major,
+                # exactly argwhere's ascending round-then-source), but
+                # the mask write and the counter land once per commit
+                # instead of once per slot.
+                rrs, srcs = np.nonzero(fresh)
+                if rrs.size:
+                    dmask[lo:hi][fresh] = True
+                    self.metrics.inc("vertices_delivered", int(rrs.size))
+                    by_round = self.dag._round_vertices
+                    log_append = self.delivered_log.append
+                    cb = self.on_deliver
+                    # per-round source dict fetched once per run of
+                    # consecutive slots (nonzero is round-major), and
+                    # the existing v.id is reused — constructing a
+                    # fresh VertexID per delivered slot was a visible
+                    # slice of the n=256 commit path
+                    cur = -1
+                    d: Dict[int, Vertex] = {}
+                    for rr, src in zip(rrs.tolist(), srcs.tolist()):
+                        if rr != cur:
+                            cur = rr
+                            d = by_round[rr + lo_round]
+                        v = d[src]
+                        log_append(v.id)
+                        if cb is not None:
+                            cb(v)
+                continue
             for rr, src in np.argwhere(fresh):
                 vid = VertexID(int(rr) + lo_round, int(src))
                 dmask[vid.round - base, vid.source] = True

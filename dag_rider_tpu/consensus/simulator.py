@@ -85,6 +85,22 @@ class Simulation:
                     log=log if log is not None else NOOP,
                 )
             )
+        self._rbc = rbc
+        # Grouped-pump registration (ISSUE 8): vector-path processes
+        # accept whole VAL runs through on_messages — one handler call
+        # per destination per run instead of one per message. Not under
+        # RBC (the broker-level handlers there belong to the Bracha
+        # stage, which must see every message singly) and only on
+        # brokers that support it (FaultyTransport et al. do not; they
+        # keep the per-message path).
+        sub_many = getattr(self.transport, "subscribe_many", None)
+        if not rbc and callable(sub_many):
+            for p in self.processes:
+                if getattr(p, "_vector", False):
+                    # on_val_batch, not on_messages: pump_grouped only
+                    # hands out pure VAL runs, so the kind re-scan is
+                    # skipped (on_messages stays the network entry)
+                    sub_many(p.index, p.on_val_batch)
 
     def _named_verifier(self, kind: str, signer_factory):
         """Convenience spelling of the common cluster shapes:
@@ -207,6 +223,26 @@ class Simulation:
         pump = getattr(self.transport, "pump", None)
         if pump is None:
             raise TypeError("transport has no pump; drive it externally")
+        # Grouped pump (ISSUE 8): byte-safe exactly when VAL delivery
+        # has no transport side effects — every process on the vector
+        # path (delivery only queues to the inbox; this run() defers
+        # steps) and no RBC stage (there even a VAL delivery broadcasts
+        # echoes at the broker layer, so cross-destination grouping
+        # would reorder the queue tail).
+        grouped = getattr(self.transport, "pump_grouped", None)
+        if (
+            callable(grouped)
+            and not self._rbc
+            and self.processes
+            and all(getattr(p, "_vector", False) for p in self.processes)
+        ):
+            pump = grouped
+            # Compress fan-out to one queue entry per broadcast; the
+            # pump expands lazily with budget-exact sentinel splitting,
+            # so boundaries match the eager queue entry-for-entry. Safe
+            # here because the subscriber set was fixed at construction.
+            if hasattr(self.transport, "fanout_sentinel"):
+                self.transport.fanout_sentinel = True
         # Cross-process dispatch coalescing: when every process shares ONE
         # Verifier instance (the bench's device configuration), all n
         # processes' burst batches merge into a single padded device
@@ -240,12 +276,15 @@ class Simulation:
         for p in self.processes:
             p.defer_steps = True
             p.defer_delivery = pipelined
+        delivered = 0
+        pump_wall = 0.0
         try:
             for p in self.processes:
                 p.start()
-            delivered = 0
             while True:
+                t0 = time.perf_counter()
                 got = pump(max_messages - delivered)
+                pump_wall += time.perf_counter() - t0
                 if coalesce:
                     batches = [p.take_verify_batch() for p in self.processes]
                     if any(batches):
@@ -398,8 +437,10 @@ class Simulation:
                                     )
                                 pos += len(b)
                             # empty batches advance nothing
+                t0 = time.perf_counter()
                 for p in self.processes:
                     p.step()
+                pump_wall += time.perf_counter() - t0
                 if got == 0 or delivered + got >= max_messages:
                     delivered += got
                     break
@@ -417,6 +458,20 @@ class Simulation:
             if isinstance(tstats, dict):
                 for p in self.processes:
                     p.metrics.observe_transport_faults(tstats)
+            # Host-pump accounting (ISSUE 8): CLUSTER-level delivered
+            # messages and pump+step wall seconds, mirrored to every
+            # process (same convention as the fault stats) — so
+            # pump_msgs_per_s reads cluster throughput; the per-round
+            # gauge divides by each process's own rounds_advanced.
+            if delivered:
+                for p in self.processes:
+                    p.metrics.observe_pump(
+                        delivered,
+                        pump_wall,
+                        "vector"
+                        if getattr(p, "_vector", False)
+                        else "scalar",
+                    )
         return delivered
 
     # -- assertions for tests ---------------------------------------------

@@ -162,6 +162,8 @@ class Node:
             # bounded DAG memory for long-running nodes (None = grow
             # forever, reference-compatible)
             gc_depth=int(gc_depth) if gc_depth is not None else None,
+            # hot-path pump flavor; None defers to DAGRIDER_PUMP / scalar
+            pump=cfg.get("pump"),
         )
         with open(cfg["keys"]) as fh:
             reg, seeds, coin_keys = load_keys(json.load(fh))

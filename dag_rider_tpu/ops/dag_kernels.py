@@ -30,6 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # ---------------------------------------------------------------------------
@@ -251,6 +252,55 @@ def closure_from_full(
         return acc | weak_contrib
 
     return lax.fori_loop(0, R, body, seeds)
+
+
+# ---------------------------------------------------------------------------
+# Host twins (numpy)
+# ---------------------------------------------------------------------------
+#
+# The vectorized host pump (consensus/process.py, DAGRIDER_PUMP=vector)
+# needs these same predicates per round, but a jitted dispatch costs
+# ~50-100 us on CPU — more than the whole batched numpy op at n=256. So
+# the hot path calls these numpy twins; tests/test_pump_vector.py pins
+# each twin equal to its jitted sibling on random DAGs so they cannot
+# drift apart. Bool @ bool numpy matmul is the established idiom here
+# (consensus/process.py _weak_edges_for).
+
+
+def reach_chain_np(strong_stack) -> "np.ndarray":
+    """Numpy twin of :func:`reach_chain`: bool[k, n, n] top round first ->
+    bool[n, n] reachability from round r_hi to round r_lo."""
+    out = strong_stack[0]
+    for s in strong_stack[1:]:
+        out = out @ s
+    return np.asarray(out, dtype=bool)
+
+
+def round_complete_np(exists_row, *, quorum: int) -> bool:
+    """Numpy twin of :func:`round_complete`."""
+    return bool(np.count_nonzero(exists_row) >= quorum)
+
+
+def admission_mask_np(strong_pred, exists_prev, weak_pred, exists):
+    """Numpy twin of :func:`admission_mask` (same shapes/semantics)."""
+    strong_ok = ~np.any(strong_pred & ~exists_prev[None, :], axis=-1)
+    weak_ok = ~np.any(weak_pred & ~exists[None, :, :], axis=(-2, -1))
+    return strong_ok & weak_ok
+
+
+def strong_edge_quorum_np(strong_pred, *, quorum: int):
+    """Numpy twin of :func:`strong_edge_quorum`: bool[B]."""
+    return np.count_nonzero(strong_pred, axis=-1) >= quorum
+
+
+def leader_reach_np(strong_stack, hi_leader: int) -> "np.ndarray":
+    """Numpy twin of :func:`leader_reach` — but seeded, so the descent is
+    vector @ matrix per round (O(k n^2)) instead of materializing the full
+    n x n chain product (O(k n^3))."""
+    vec = np.asarray(strong_stack[0][hi_leader], dtype=bool)
+    for s in strong_stack[1:]:
+        vec = vec @ s
+    return np.asarray(vec, dtype=bool)
 
 
 @jax.jit

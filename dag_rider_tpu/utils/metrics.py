@@ -118,6 +118,13 @@ class Metrics:
         #: latest mempool gauge dict (Mempool.stats) — None until a
         #: mempool is attached to this process's node
         self.mempool: Dict | None = None
+        #: round-12 host-pump accounting (ISSUE 8): messages delivered
+        #: through the consensus pump and the wall seconds the driver
+        #: spent pumping + stepping, plus which path ran. None until a
+        #: pump-aware driver (Simulation.run / node pump loop) reports.
+        self.pump_msgs_total = 0
+        self.pump_seconds_total = 0.0
+        self.pump_path: str | None = None
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -210,6 +217,17 @@ class Metrics:
         """Latest mempool gauges (Mempool.stats): depth, admitted/
         shed/deduped/expired counters, batch fill, backpressure state."""
         self.mempool = dict(stats)
+
+    def observe_pump(self, msgs: int, seconds: float, path: str) -> None:
+        """Host consensus-pump accounting from the driving loop:
+        cumulative messages delivered, wall seconds spent in
+        pump + step, and the active path ("scalar" | "vector"). The
+        1.2 s/round floor ISSUE 8 attacks becomes first-class
+        observable as host_pump_ms_per_round / pump_msgs_per_s in the
+        snapshot instead of hand-derived in PROFILE."""
+        self.pump_msgs_total += int(msgs)
+        self.pump_seconds_total += float(seconds)
+        self.pump_path = path
 
     def mark_verify_amortized(self) -> None:
         """Flag this process's verify timings as AMORTIZED: under the
@@ -310,6 +328,20 @@ class Metrics:
                     out["mempool_backpressure"] = ladder.get(v, -1)
                 elif isinstance(v, (int, float)):
                     out[f"mempool_{k}"] = v
+        if self.pump_path is not None:
+            # numeric gauge (same convention as mempool_backpressure)
+            out["pump_path"] = {"scalar": 0, "vector": 1}.get(
+                self.pump_path, -1
+            )
+            if self.pump_seconds_total > 0.0:
+                out["pump_msgs_per_s"] = round(
+                    self.pump_msgs_total / self.pump_seconds_total, 1
+                )
+                rounds = self.counters.get("rounds_advanced", 0)
+                if rounds:
+                    out["host_pump_ms_per_round"] = round(
+                        1e3 * self.pump_seconds_total / rounds, 3
+                    )
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)
         if self.wave_interval_seconds:

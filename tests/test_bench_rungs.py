@@ -72,3 +72,19 @@ def test_sim_rung_pipeline_off_runs_and_restores_seam():
     assert v.pipeline_enabled is True
     pending = v.dispatch_batch([])
     assert v.resolve_batch(pending) == []
+
+
+def test_vec_ab_rung_asserts_equal_commit_order():
+    """The round-12 scalar-vs-vector A/B: both sides must reach the
+    target round, the entry must carry both throughput sides + the
+    speedup ratio, and the rung itself enforces byte-identical per-view
+    commit order (it raises on divergence — the tier1-vec CI smoke
+    relies on that)."""
+    e = bench._vec_ab_rung(8, 30.0, 8)
+    assert e["commit_order_identical"] is True
+    assert e["scalar"]["max_round"] >= 8
+    assert e["vector"]["max_round"] >= 8
+    assert e["scalar"]["msgs_per_sec"] > 0
+    assert e["vector"]["msgs_per_sec"] > 0
+    assert e["speedup"] > 0
+    assert e["scalar"]["vertices_delivered_total"] > 0

@@ -180,8 +180,21 @@ def test_blocked_memo_reevaluated_after_prune_passes_weak_target():
     floor must be re-evaluated and admitted (the below-base weak rule),
     not held forever by the stale blocked-on memo (round-4 review).
     Driven directly (a full sim's retroactive chains jump the floor
-    several waves per commit, racing the observation window)."""
-    p = Process(GC, 0, InMemoryTransport())
+    several waves per commit, racing the observation window). Pinned to
+    the scalar pump: the ``_blocked_on`` memo it asserts is a scalar
+    drain internal (the vector drain re-checks batches wholesale)."""
+    p = Process(
+        Config(
+            n=4,
+            coin="round_robin",
+            propose_empty=True,
+            gc_depth=16,
+            sync_window=8,
+            pump="scalar",
+        ),
+        0,
+        InMemoryTransport(),
+    )
     # full rounds 1..8 from sources 0..2; source 3 is permanently absent
     for r in range(1, 9):
         prev = tuple(

@@ -1,0 +1,312 @@
+"""Scalar-vs-vector consensus pump equivalence (round 12).
+
+The vectorized pump is an EXECUTION STRATEGY, not a protocol change: for
+every schedule the scalar path can see, the vector path must produce
+byte-identical per-process delivery sequences (same vertex ids, same
+digests, same order). This suite pins that contract three ways:
+
+- unit: the batch codec roundtrips, and every numpy host twin in
+  ops/dag_kernels.py agrees with its jitted sibling on random inputs
+  (the twins are what the vector drain/ordering actually call on the
+  1-core host; the jitted forms remain the device reference);
+- transport: pump_grouped preserves per-destination FIFO, treats
+  control messages as barriers, and falls back per-message when no
+  batch handler is registered;
+- end-to-end fuzz: paired simulations (identical seeds, transports,
+  adversaries — only cfg.pump differs) across committee sizes, Byzantine
+  scenarios from the round-11 suite, and the Bracha RBC stage, compared
+  delivery-log to delivery-log.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus import Process, Simulation
+from dag_rider_tpu.consensus.adversary import ByzantineProcess, make_behavior
+from dag_rider_tpu.core import codec
+from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.transport import InMemoryTransport
+from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
+
+# ---------------------------------------------------------------------------
+# knob plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_pump_defaults_to_scalar(monkeypatch):
+    monkeypatch.delenv("DAGRIDER_PUMP", raising=False)
+    assert Config(n=4).pump == "scalar"
+
+
+def test_pump_env_resolution(monkeypatch):
+    monkeypatch.setenv("DAGRIDER_PUMP", "vector")
+    assert Config(n=4).pump == "vector"
+
+
+def test_pump_explicit_beats_env(monkeypatch):
+    monkeypatch.setenv("DAGRIDER_PUMP", "vector")
+    assert Config(n=4, pump="scalar").pump == "scalar"
+
+
+def test_pump_validation():
+    with pytest.raises(ValueError):
+        Config(n=4, pump="simd")
+
+
+# ---------------------------------------------------------------------------
+# batch codec
+# ---------------------------------------------------------------------------
+
+
+def _val(sender: int, rnd: int) -> BroadcastMessage:
+    v = Vertex(
+        id=VertexID(rnd, sender),
+        block=Block((f"b{sender}-{rnd}".encode(),)),
+        strong_edges=tuple(VertexID(rnd - 1, s) for s in range(3)),
+        weak_edges=(VertexID(max(0, rnd - 2), 3),) if rnd > 1 else (),
+    )
+    return BroadcastMessage(vertex=v, round=rnd, sender=sender)
+
+
+def test_encode_decode_many_roundtrip():
+    msgs = [_val(s, r) for r in (1, 2, 3) for s in range(4)]
+    # a control message in the middle: the batch frame is kind-agnostic
+    msgs.insert(
+        3,
+        BroadcastMessage(
+            vertex=None,
+            round=2,
+            sender=1,
+            kind="echo",
+            origin=0,
+            digest=b"\x00" * 32,
+        ),
+    )
+    out = codec.decode_many(codec.encode_many(msgs))
+    assert len(out) == len(msgs)
+    for a, b in zip(msgs, out):
+        assert (a.kind, a.round, a.sender, a.origin, a.digest) == (
+            b.kind,
+            b.round,
+            b.sender,
+            b.origin,
+            b.digest,
+        )
+        if a.vertex is None:
+            assert b.vertex is None
+        else:
+            assert a.vertex.id == b.vertex.id
+            assert a.vertex.digest() == b.vertex.digest()
+
+
+def test_encode_decode_many_empty():
+    assert codec.decode_many(codec.encode_many([])) == []
+
+
+def test_decode_many_rejects_trailing_bytes():
+    blob = codec.encode_many([_val(0, 1)])
+    with pytest.raises(ValueError):
+        codec.decode_many(blob + b"x")
+
+
+def test_decode_many_rejects_bad_magic():
+    with pytest.raises(ValueError):
+        codec.decode_many(b"XXXX\x00\x00\x00\x00")
+
+
+# ---------------------------------------------------------------------------
+# numpy host twins == jitted kernels
+# ---------------------------------------------------------------------------
+
+
+def test_host_twins_match_jitted_kernels():
+    from dag_rider_tpu.ops import dag_kernels as dk
+
+    rng = np.random.default_rng(7)
+    n, quorum = 8, 6
+    for k in (1, 2, 4):
+        stack = rng.random((k, n, n)) < 0.3
+        jit_reach = np.asarray(dk.reach_chain(stack))
+        np.testing.assert_array_equal(jit_reach, dk.reach_chain_np(stack))
+        for hi in range(n):
+            np.testing.assert_array_equal(
+                np.asarray(dk.leader_reach(stack, hi)),
+                dk.leader_reach_np(stack, hi),
+            )
+    for _ in range(8):
+        row = rng.random(n) < 0.7
+        assert bool(
+            dk.round_complete(row, quorum=quorum)
+        ) == dk.round_complete_np(row, quorum=quorum)
+        sp = rng.random((5, n)) < 0.6
+        np.testing.assert_array_equal(
+            np.asarray(dk.strong_edge_quorum(sp, quorum=quorum)),
+            dk.strong_edge_quorum_np(sp, quorum=quorum),
+        )
+        ex = rng.random((6, n)) < 0.5
+        wp = rng.random((5, 6, n)) < 0.1
+        np.testing.assert_array_equal(
+            np.asarray(dk.admission_mask(sp, ex[2], wp, ex)),
+            dk.admission_mask_np(sp, ex[2], wp, ex),
+        )
+
+
+# ---------------------------------------------------------------------------
+# pump_grouped transport semantics
+# ---------------------------------------------------------------------------
+
+
+def test_pump_grouped_batches_val_runs_and_barriers_controls():
+    tp = InMemoryTransport()
+    events = []
+    tp.subscribe(0, lambda m: events.append(("one", m.kind, m.round)))
+    tp.subscribe(1, lambda m: events.append(("other", m.kind, m.round)))
+    tp.subscribe_many(
+        0, lambda ms: events.append(("batch", [m.round for m in ms]))
+    )
+    for r in (1, 2):
+        tp.enqueue(0, _val(1, r))
+    ctrl = BroadcastMessage(
+        vertex=None, round=2, sender=1, kind="echo", origin=1, digest=b"d"
+    )
+    tp.enqueue(0, ctrl)
+    tp.enqueue(1, _val(0, 3))  # no batch handler: per-message fallback
+    tp.enqueue(0, _val(1, 4))
+    assert tp.pump_grouped() == 5
+    assert events == [
+        ("batch", [1, 2]),  # VAL run, per-dest FIFO preserved
+        ("one", "echo", 2),  # control barrier in exact queue position
+        ("other", "val", 3),  # fallback path
+        ("batch", [4]),
+    ]
+
+
+def test_subscribe_many_requires_existing_subscription():
+    tp = InMemoryTransport()
+    with pytest.raises(KeyError):
+        tp.subscribe_many(0, lambda ms: None)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end equivalence fuzz
+# ---------------------------------------------------------------------------
+
+
+def _delivery_logs(sim: Simulation, honest) -> list:
+    return [
+        [(v.id, v.digest()) for v in sim.deliveries[i]] for i in honest
+    ]
+
+
+def _run_clean(n: int, seed: int, pump: str, *, rbc: bool, target: int):
+    cfg = Config(
+        n=n, coin="round_robin", propose_empty=True, gc_depth=24, pump=pump
+    )
+    sim = Simulation(cfg, rbc=rbc)
+    for i in range(n):
+        for k in range(2):
+            sim.processes[i].submit(
+                Block((f"s{seed}-p{i}-b{k}".encode().ljust(32, b"."),))
+            )
+    chunk = n * (n - 1) * (2 * n if rbc else 1)
+    for _ in range(100 * target):
+        sim.run(max_messages=chunk)
+        if max(p.round for p in sim.processes) >= target:
+            break
+    else:
+        raise AssertionError("failed to reach target round")
+    sim.check_agreement()
+    return _delivery_logs(sim, range(n))
+
+
+@pytest.mark.parametrize("n", [4, 16, 32])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_clean_equivalence(n, seed):
+    target = 12 if n == 4 else 8
+    scalar = _run_clean(n, seed, "scalar", rbc=False, target=target)
+    vector = _run_clean(n, seed, "vector", rbc=False, target=target)
+    assert any(scalar)  # non-vacuous: something was delivered
+    assert scalar == vector
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_clean_equivalence_under_rbc(seed):
+    scalar = _run_clean(4, seed, "scalar", rbc=True, target=12)
+    vector = _run_clean(4, seed, "vector", rbc=True, target=12)
+    assert any(scalar)
+    assert scalar == vector
+
+
+def _run_adversary(
+    n: int, seed: int, pump: str, adversary: str, *, rbc: bool, cycles: int
+):
+    """Mirror of scenarios.run_scenario's core loop with cfg.pump pinned:
+    seeded behaviors at the low f indices, a seeded fault transport, a
+    fixed virtual-time schedule — only the pump flavor differs between
+    the paired calls, so the delivery logs must match byte for byte."""
+    cfg = Config(
+        n=n,
+        propose_empty=True,
+        pump=pump,
+        sync_request_cooldown_s=0.0,
+        sync_serve_cooldown_s=0.0,
+    )
+    byz = tuple(range(cfg.f))
+    behaviors = {
+        i: make_behavior(adversary, seed=seed + 1000 + i) for i in byz
+    }
+    tp = FaultyTransport(FaultPlan(seed=seed))
+
+    def factory(pcfg, i, ptp, **kwargs):
+        if i in behaviors:
+            return ByzantineProcess(
+                pcfg, i, ptp, behavior=behaviors[i], **kwargs
+            )
+        return Process(pcfg, i, ptp, **kwargs)
+
+    sim = Simulation(cfg, transport=tp, rbc=rbc, process_factory=factory)
+    honest = [i for i in range(n) if i not in set(byz)]
+    for i in honest:
+        for k in range(2):
+            sim.processes[i].submit(
+                Block((f"s{seed}-p{i}-b{k}".encode().ljust(32, b"."),))
+            )
+    chunk = 2 * n * n * (2 * n if rbc else 1)
+    for _ in range(cycles):
+        if sim.run(max_messages=chunk) == 0:
+            for _ in range(cfg.sync_patience or 4):
+                sim.run(max_messages=chunk)
+        tp.advance(0.01)
+    for _ in range(6):
+        tp.flush_delayed()
+        sim.run(max_messages=2 * chunk)
+    return _delivery_logs(sim, honest)
+
+
+@pytest.mark.parametrize(
+    "adversary", ["equivocate", "withhold", "invalid_edges"]
+)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_adversary_equivalence(adversary, seed):
+    scalar = _run_adversary(
+        4, seed, "scalar", adversary, rbc=False, cycles=36
+    )
+    vector = _run_adversary(
+        4, seed, "vector", adversary, rbc=False, cycles=36
+    )
+    assert any(scalar)
+    assert scalar == vector
+
+
+def test_adversary_equivalence_under_rbc():
+    scalar = _run_adversary(
+        4, 0, "scalar", "equivocate", rbc=True, cycles=36
+    )
+    vector = _run_adversary(
+        4, 0, "vector", "equivocate", rbc=True, cycles=36
+    )
+    assert any(scalar)
+    assert scalar == vector
