@@ -546,6 +546,220 @@ def _vec_ab_rung(n: int, budget_s: float, target_round: int) -> dict:
     return entry
 
 
+def _agg_ladder_rung(sizes=(64, 256)) -> dict:
+    """verify_n256_agg ladder rung (round 13): component costs of the
+    aggregated round-certificate check at committee quorums vs the
+    per-vertex ed25519 reference.
+
+    Honesty notes on "flat in n": what is flat is the signature-OP count
+    (one aggregate check per round regardless of n, vs n per-vertex
+    verifies) and the per-vertex-AMORTIZED check cost (``agg_check_warm_s
+    / n`` — the shared Miller squarings and the single final
+    exponentiation amortize over a bigger round). The raw host check
+    still grows with the pair count — sublinearly (4x pairs should cost
+    well under 4x wall; that ratio is ``agg_check_growth``) but it
+    grows; the device-work claim is carried by the op counts and the MSM
+    seam, not by host pairing wall time."""
+    import hashlib
+    import time as _t
+
+    from dag_rider_tpu.crypto import bls12381 as _bls
+    from dag_rider_tpu.crypto import ed25519 as _ed
+    from dag_rider_tpu.ops import bls_msm as _msm
+    from dag_rider_tpu.verifier.base import CertSigner, KeyRegistry
+    from dag_rider_tpu.verifier.cert import CertVerifier
+
+    entry: dict = {"sizes": {}}
+    for n in sizes:
+        q = _quorum(n)
+        reg, _seeds, sks = KeyRegistry.generate_with_cert(n)
+        cv = CertVerifier(reg, q, msm="host")
+        digests = [
+            hashlib.sha256(b"agg-rung|%d|%d" % (n, i)).digest()
+            for i in range(q)
+        ]
+        signers = [CertSigner(sk) for sk in sks[:q]]
+        t0 = _t.monotonic()
+        shares = [
+            s.sign_digest(d) for s, d in zip(signers, digests)
+        ]
+        sign_s = _t.monotonic() - t0
+        t0 = _t.monotonic()
+        cert = cv.make_certificate(
+            1, list(zip(range(q), digests, shares))
+        )
+        assemble_s = _t.monotonic() - t0
+        # the device MSM seam must land on the host group-law point;
+        # compile outside the timed box (each padded batch size is its
+        # own program) and report the warm dispatch. The half is
+        # skippable: on the 1-core fallback the compile alone can eat
+        # minutes at the n=256 padding.
+        size_entry_extra: dict = {}
+        if os.environ.get("DAGRIDER_BENCH_AGG_DEVMSM", "1") == "1":
+            pts = [_bls.g1_decompress(s) for s in shares]
+            t0 = _t.monotonic()
+            dev_pt = _msm.sum_points(pts)  # compile + run
+            compile_s = _t.monotonic() - t0
+            t0 = _t.monotonic()
+            dev_pt = _msm.sum_points(pts)
+            msm_device_s = _t.monotonic() - t0
+            msm_match = _bls.g1_compress(dev_pt) == cert.agg_sig
+            size_entry_extra = {
+                "msm_device_ms": round(msm_device_s * 1000, 1),
+                "msm_device_compile_s": round(compile_s, 1),
+                "msm_match": msm_match,
+            }
+        else:
+            msm_match = True
+        # _check (not verify_certificate): the memo would turn the warm
+        # timings into dict hits
+        t0 = _t.monotonic()
+        ok_cold = cv._check(cert)
+        cold_s = _t.monotonic() - t0
+        warms = []
+        for _ in range(2):
+            t0 = _t.monotonic()
+            ok_warm = cv._check(cert)
+            warms.append(_t.monotonic() - t0)
+        warm_s = min(warms)
+        if not (ok_cold and ok_warm and msm_match):
+            raise AssertionError(
+                f"agg rung n={n}: check/MSM disagreement "
+                f"(cold={ok_cold} warm={ok_warm} msm={msm_match})"
+            )
+        # per-vertex reference: the n ed25519 verifies the round costs
+        # every receiver without the certificate
+        esk, epk = _ed.generate_keypair(
+            hashlib.sha256(b"agg-rung-ed|%d" % n).digest()
+        )
+        msgs = [
+            hashlib.sha256(b"agg-rung-msg|%d|%d" % (n, i)).digest()
+            for i in range(n)
+        ]
+        esigs = [_ed.sign(esk, m) for m in msgs]
+        _ed.verify(epk, msgs[0], esigs[0])  # warm the comb tables
+        t0 = _t.monotonic()
+        for m, s in zip(msgs, esigs):
+            if not _ed.verify(epk, m, s):
+                raise AssertionError("ed25519 reference verify failed")
+        ref_s = _t.monotonic() - t0
+        entry["sizes"][str(n)] = {
+            "quorum": q,
+            "pairs": q + 1,
+            "share_sign_ms_per_vertex": round(sign_s / q * 1000, 2),
+            "assemble_ms": round(assemble_s * 1000, 1),
+            **size_entry_extra,
+            "agg_check_cold_s": round(cold_s, 3),
+            "agg_check_warm_s": round(warm_s, 3),
+            "agg_check_ms_per_vertex": round(warm_s / n * 1000, 2),
+            "per_vertex_ed25519_s": round(ref_s, 3),
+            "per_vertex_ms_per_sig": round(ref_s / n * 1000, 2),
+            "verify_ops_agg": 1,
+            "verify_ops_per_vertex": n,
+        }
+    lo, hi = str(sizes[0]), str(sizes[-1])
+    a, b = entry["sizes"][lo], entry["sizes"][hi]
+    entry["pairs_growth"] = round(b["pairs"] / a["pairs"], 2)
+    entry["agg_check_growth"] = round(
+        b["agg_check_warm_s"] / a["agg_check_warm_s"], 2
+    )
+    entry["per_vertex_growth"] = round(
+        b["per_vertex_ed25519_s"] / a["per_vertex_ed25519_s"], 2
+    )
+    # the acceptance headline: per-round verify cost amortized per
+    # vertex stays ~flat (within 2x) on the agg path while the
+    # per-vertex path pays linearly more ops
+    entry["agg_ms_per_vertex_growth"] = round(
+        b["agg_check_ms_per_vertex"] / a["agg_check_ms_per_vertex"], 2
+    )
+    entry["agg_per_vertex_flat_within_2x"] = (
+        entry["agg_ms_per_vertex_growth"] <= 2.0
+    )
+    entry["verify_ops_growth_agg"] = 1.0
+    return entry
+
+
+def _cert_ab_rung(n: int, blocks: int = 6) -> dict:
+    """Aggregated-certificate sim A/B (round 13): paired cert-on /
+    cert-off runs — same committee, same blocks, same vector pump, same
+    shared CPU-oracle verifier — compared delivery-log to delivery-log.
+    ``sigs_device`` sums each process's requested verify dispatches
+    (``verify_sigs_total``, counted BEFORE the in-process cluster's
+    cross-process dedup — i.e. what every node's own device pays in a
+    real deployment, n-1 per round per receiver); the certificate path
+    must cut the cluster-wide count by ~n while the commit order stays
+    byte-identical. Raises on divergence."""
+    import time as _t
+
+    from dag_rider_tpu.config import Config
+    from dag_rider_tpu.consensus.simulator import Simulation
+    from dag_rider_tpu.core.types import Block
+
+    sides: dict = {}
+    orders: dict = {}
+    for mode in ("per_vertex", "agg"):
+        cfg = Config(
+            n=n, coin="round_robin", propose_empty=False, pump="vector"
+        )
+        sim = Simulation(cfg, verifier="cpu", cert=(mode == "agg"))
+        for i in range(n):
+            for k in range(blocks):
+                sim.processes[i].submit(
+                    Block((f"p{i}-blk{k}".encode().ljust(32, b"."),))
+                )
+        t0 = _t.monotonic()
+        sim.run(max_messages=100 * n * n)
+        dt = _t.monotonic() - t0
+        sim.check_agreement()
+        snaps = [p.metrics.snapshot() for p in sim.processes]
+        orders[mode] = [
+            [(v.id, v.digest()) for v in d] for d in sim.deliveries
+        ]
+        sides[mode] = {
+            "seconds": round(dt, 2),
+            "sigs_device": sum(
+                s.get("verify_sigs_total", 0) for s in snaps
+            ),
+            "certs_assembled": sum(
+                s.get("certs_assembled", 0) for s in snaps
+            ),
+            "certs_rejected": sum(
+                s.get("certs_rejected", 0) for s in snaps
+            ),
+            "cert_timeouts": sum(
+                s.get("cert_timeouts", 0) for s in snaps
+            ),
+            "sigs_saved": sum(s.get("sigs_saved", 0) for s in snaps),
+            "cert_fastpath_fraction": round(
+                sum(s.get("cert_fastpath_fraction", 0.0) for s in snaps)
+                / len(snaps),
+                4,
+            ),
+            "max_round": max(p.round for p in sim.processes),
+            "vertices_delivered_total": sum(
+                len(d) for d in sim.deliveries
+            ),
+        }
+    identical = orders["per_vertex"] == orders["agg"]
+    ref_sigs = max(sides["per_vertex"]["sigs_device"], 1)
+    entry = {
+        "nodes": n,
+        "blocks_per_process": blocks,
+        "per_vertex": sides["per_vertex"],
+        "agg": sides["agg"],
+        "commit_order_identical": identical,
+        "sigs_device_drop": round(
+            ref_sigs / max(sides["agg"]["sigs_device"], 1), 1
+        ),
+    }
+    if not identical:
+        raise AssertionError(
+            f"sim{n}_agg: certificate path diverged from per-vertex "
+            "commit order"
+        )
+    return entry
+
+
 def _measure() -> None:
     budget = float(os.environ.get("DAGRIDER_BENCH_SECONDS", "300"))
     t_start = time.monotonic()
@@ -1068,6 +1282,71 @@ def _measure() -> None:
             f"({entry['speedup']}x), commit order identical"
         )
         emit()
+
+    # -- ladder rungs (round 13): aggregated round certificates. Two
+    # halves — verify_n256_agg prices the aggregate-check components at
+    # the n=64/n=256 quorums against the per-vertex ed25519 reference,
+    # and sim{n}_agg runs the cert-on/cert-off sim A/B (byte-identical
+    # commit order, cluster-wide sigs_device drop). Off by default (the
+    # host pairing halves eat ~1 min); a local capture sets
+    # DAGRIDER_BENCH_AGG=1 (+ _AGG_N for the sim committee size) and
+    # gets BENCH_r06.json when both halves pass.
+    agg_on = os.environ.get("DAGRIDER_BENCH_AGG", "") == "1"
+    agg_n = int(os.environ.get("DAGRIDER_BENCH_AGG_N", "64"))
+    if agg_on and left() > 30:
+        agg_ok = sim_ok = False
+        try:
+            _mark("ladder verify_n256_agg: aggregate-check components")
+            entry = _agg_ladder_rung()
+            result["ladder"]["verify_n256_agg"] = entry
+            agg_ok = entry["agg_per_vertex_flat_within_2x"]
+            _mark(
+                "ladder verify_n256_agg: check "
+                f"{entry['sizes']['64']['agg_check_warm_s']}s@64 -> "
+                f"{entry['sizes']['256']['agg_check_warm_s']}s@256 "
+                f"({entry['agg_check_growth']}x wall for "
+                f"{entry['pairs_growth']}x pairs; per-vertex amortized "
+                f"{entry['agg_ms_per_vertex_growth']}x)"
+            )
+            emit()
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder verify_n256_agg FAILED: {e!r}")
+        try:
+            tag = f"sim{agg_n}_agg"
+            _mark(f"ladder {tag}: cert-on/cert-off sim A/B")
+            entry = _cert_ab_rung(agg_n)
+            result["ladder"][tag] = entry
+            sim_ok = (
+                entry["commit_order_identical"]
+                and entry["sigs_device_drop"] >= 10.0
+            )
+            _mark(
+                f"ladder {tag}: sigs_device "
+                f"{entry['per_vertex']['sigs_device']} -> "
+                f"{entry['agg']['sigs_device']} "
+                f"({entry['sigs_device_drop']}x drop), commit order "
+                "identical"
+            )
+            emit()
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder {tag} FAILED: {e!r}")
+        if agg_ok and sim_ok:
+            rec = {
+                "verify_n256_agg": result["ladder"]["verify_n256_agg"],
+                f"sim{agg_n}_agg": result["ladder"][f"sim{agg_n}_agg"],
+                "backend": result.get("backend", "cpu"),
+                "device_kind": result.get("device_kind", "cpu"),
+                "ok": True,
+                "skipped": False,
+            }
+            out_path = os.path.join(
+                _REPO,
+                os.environ.get("DAGRIDER_AGG_OUT", "BENCH_r06.json"),
+            )
+            with open(out_path, "w") as fh:
+                json.dump(rec, fh, indent=1)
+                fh.write("\n")
+            _mark(f"ladder agg: wrote {out_path}")
 
     # -- ladder rung #9 (round 10): mempool-fronted end-to-end commit
     # pipeline — client transactions through admission/batching/consensus

@@ -90,6 +90,19 @@ class Config:
     # gate). None resolves from DAGRIDER_PUMP, defaulting to "scalar";
     # an explicit value beats the environment.
     pump: Optional[str] = None
+    # Aggregated round certificates (ISSUE 9): "off" keeps the per-vertex
+    # verify path as the reference oracle; "agg" BLS-signs vertex digests
+    # and lets the round's designated aggregator gossip one
+    # RoundCertificate that peers check with a single aggregate pairing
+    # instead of n per-vertex verifies. Same resolution rule as pump:
+    # None reads DAGRIDER_CERT, explicit beats env.
+    cert: Optional[str] = None
+    # Quiescent step() passes a non-aggregator waits on a round's
+    # certificate before giving up and re-verifying that round per-vertex
+    # (the Byzantine-aggregator liveness valve). Must exceed the clean
+    # cert latency of 1-2 steps and stay below sync_patience so a silent
+    # aggregator degrades locally before the sync machinery fires.
+    cert_patience: int = 6
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -103,6 +116,20 @@ class Config:
         if self.pump not in ("scalar", "vector"):
             raise ValueError(
                 f'pump must be "scalar" or "vector", got {self.pump!r}'
+            )
+        if self.cert is None:
+            object.__setattr__(
+                self,
+                "cert",
+                os.environ.get("DAGRIDER_CERT", "").strip() or "off",
+            )
+        if self.cert not in ("off", "agg"):
+            raise ValueError(
+                f'cert must be "off" or "agg", got {self.cert!r}'
+            )
+        if self.cert_patience < 1:
+            raise ValueError(
+                f"cert_patience must be >= 1, got {self.cert_patience}"
             )
         if self.f is None:
             object.__setattr__(self, "f", (self.n - 1) // 3)

@@ -44,7 +44,13 @@ from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus.coin import CommonCoin, FixedCoin, RoundRobinCoin
 from dag_rider_tpu.consensus.dag_state import DagState
 from dag_rider_tpu.core.stack import Stack
-from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.core.types import (
+    Block,
+    BroadcastMessage,
+    RoundCertificate,
+    Vertex,
+    VertexID,
+)
 from dag_rider_tpu.transport.base import Transport, resolve_unicast
 from dag_rider_tpu.utils.metrics import Metrics, Timer
 from dag_rider_tpu.utils.slog import NOOP, EventLog
@@ -65,6 +71,8 @@ class Process:
         coin: Optional[CommonCoin] = None,
         verifier=None,
         signer=None,
+        cert_signer=None,
+        cert_verifier=None,
         on_deliver: Optional[DeliverCallback] = None,
         log: EventLog = NOOP,
     ) -> None:
@@ -76,6 +84,8 @@ class Process:
         self.coin = coin if coin is not None else self._default_coin(cfg)
         self.verifier = verifier
         self.signer = signer
+        self.cert_signer = cert_signer
+        self.cert_verifier = cert_verifier
         self.on_deliver = on_deliver
         # Structured event log (SURVEY §5 L5; the reference has 3 zap
         # Debug sites — here every state transition emits a typed event).
@@ -101,11 +111,13 @@ class Process:
         #: only; control messages are never deferred).
         self._inbox: List[BroadcastMessage] = []
         self._buffer: List[Vertex] = []
-        #: vector-mode buffer storage: round -> {vid: vertex} in arrival
-        #: order (dicts preserve insertion order; the vid key doubles as
-        #: the duplicate-membership probe, replacing the per-message
-        #: _buffered_ids add/discard churn of the scalar path).
-        self._buffer_rounds: Dict[int, Dict[VertexID, Vertex]] = {}
+        #: vector-mode buffer storage: round -> {source: vertex} in
+        #: arrival order (dicts preserve insertion order; the source key
+        #: doubles as the duplicate-membership probe — within one round a
+        #: (round, source) collision IS a vid collision, and an int key
+        #: skips the VertexID tuple hash the PROFILE round-12 flame chart
+        #: charges ~0.5s of dict.get to).
+        self._buffer_rounds: Dict[int, Dict[int, Vertex]] = {}
         #: scalar-mode buffer membership mirror; vector mode keys the
         #: round groups by vid instead and leaves this set empty.
         self._buffered_ids: Set[VertexID] = set()
@@ -168,8 +180,44 @@ class Process:
         #: falls behind until the floors-above-round rule flips
         #: state_transfer_needed, the designed recovery.
         self._attested_floor = 0
-        self._seen_digests: Dict[VertexID, bytes] = {}
+        #: equivocation book, round -> n-slot digest list indexed by
+        #: source (satellite of ISSUE 9: the vid-keyed dict was the
+        #: hottest memo in the round-12 profile — a list index replaces
+        #: the tuple hash). Trimmed with the GC floor like the dag.
+        self._seen_digests: Dict[int, List[Optional[bytes]]] = {}
+        # -- aggregated round certificates (ISSUE 9) -------------------
+        #: cert fast path is live only when the knob, a verifier, and
+        #: both cert-key seams are present; otherwise every field below
+        #: stays empty and the per-vertex path is untouched.
+        self._cert = (
+            cfg.cert == "agg"
+            and verifier is not None
+            and cert_signer is not None
+            and cert_verifier is not None
+        )
+        #: round -> {source: vertex} awaiting that round's certificate
+        #: (non-aggregator rounds only)
+        self._cert_pool: Dict[int, Dict[int, Vertex]] = {}
+        #: aggregator-side: round -> {source: (digest, cert_sig)} of
+        #: directly verified vertices, consumed by _maybe_assemble_certs
+        self._cert_stash: Dict[int, Dict[int, tuple]] = {}
+        #: rounds settled either way (cert applied or degraded) — later
+        #: copies take the normal per-vertex path
+        self._cert_done: Set[int] = set()
+        #: rounds whose certificate we already assembled and gossiped
+        self._certs_sent: Set[int] = set()
+        #: round -> steps spent waiting on its certificate; exceeding
+        #: cfg.cert_patience degrades the round to per-vertex verifies
+        #: (a Byzantine aggregator can cost a round its fast path, never
+        #: its liveness)
+        self._cert_wait: Dict[int, int] = {}
+        #: certificates received but not yet applied (application runs in
+        #: step(), after _process_inbox, so a cert can never outrun the
+        #: VALs it covers through the deferred-inbox path)
+        self._pending_certs: List[RoundCertificate] = []
         self.metrics = Metrics()
+        if self._cert:
+            self.metrics.counters["cert_path_enabled"] = 1
         self._started = False
         # Burst delivery (the north-star batching shape): when True,
         # ``on_message`` only queues — the driver (Simulation pump / net
@@ -221,9 +269,9 @@ class Process:
     @buffer.setter
     def buffer(self, vs: List[Vertex]) -> None:
         if self._vector:
-            groups: Dict[int, Dict[VertexID, Vertex]] = {}
+            groups: Dict[int, Dict[int, Vertex]] = {}
             for v in vs:
-                groups.setdefault(v.id.round, {})[v.id] = v
+                groups.setdefault(v.id.round, {})[v.id.source] = v
             self._buffer_rounds = groups
         else:
             self._buffer = vs
@@ -299,12 +347,15 @@ class Process:
             # stage's floor gate covers only RBC deployments).
             self.metrics.inc("msgs_below_gc_horizon")
             return
+        pooled = self._cert_pool.get(v.id.round) if self._cert else None
         if (
             self.dag.present(v.id)
             or v.id in self._buffered_ids
             or v.id in self._pending_verify_ids
+            or (pooled is not None and v.id.source in pooled)
         ):
-            prev = self._seen_digests.get(v.id)
+            row = self._seen_digests.get(v.id.round)
+            prev = row[v.id.source] if row is not None else None
             if prev is not None and prev != v.digest():
                 # same (round, source), different content — equivocation.
                 self.metrics.inc("equivocations_detected")
@@ -324,10 +375,20 @@ class Process:
                 weak=len(v.weak_edges),
             )
             return
-        self._seen_digests[v.id] = v.digest()
+        self._note_seen(v)
         if self.verifier is not None:
-            self._pending_verify.append(v)
-            self._pending_verify_ids.add(v.id)
+            if (
+                self._cert
+                and v.id.round % self.cfg.n != self.index
+                and v.id.round not in self._cert_done
+            ):
+                # await this round's certificate instead of paying a
+                # per-vertex verify; patience degrades us back if the
+                # aggregator never delivers
+                self._cert_pool.setdefault(v.id.round, {})[v.id.source] = v
+            else:
+                self._pending_verify.append(v)
+                self._pending_verify_ids.add(v.id)
         else:
             self._admit_to_buffer(v)
         if self._started and not self.defer_steps:
@@ -340,6 +401,8 @@ class Process:
             self._serve_sync(msg)
         elif msg.kind == "sync_nack":
             self._on_sync_nack(msg)
+        elif msg.kind == "cert":
+            self._on_certificate(msg)
         else:
             # RBC control traffic (echo/ready/fetch) is consumed by the
             # transport/rbc.py stage; a Process only eats vertex payloads.
@@ -407,15 +470,24 @@ class Process:
         wave_len = self.cfg.wave_length
         dag = self.dag
         base = dag.base_round  # nothing in this loop prunes
-        vertices = dag.vertices
+        exists = dag.exists
+        n_rows = exists.shape[0]
         groups = self._buffer_rounds
         pending = self._pending_verify_ids
         seen = self._seen_digests
         metrics_inc = self.metrics.inc
         verifier = self.verifier
         observe_share = self.coin.observe_share
+        cert_on = self._cert
+        cert_pool = self._cert_pool
+        cert_done = self._cert_done
+        my_index = self.index
         last_r = -1  # round-group cache: batches arrive in same-round runs
-        grp: Optional[Dict[VertexID, Vertex]] = None
+        grp: Optional[Dict[int, Vertex]] = None
+        seen_row: Optional[List[Optional[bytes]]] = None
+        exists_row: Optional[list] = None
+        pool_row: Optional[Dict[int, Vertex]] = None
+        pool_this = False
         for msg in inbox:
             v = msg.vertex
             ok = msg.__dict__.get("_stamp_ok")
@@ -442,16 +514,30 @@ class Process:
             if r != last_r:
                 last_r = r
                 grp = groups.get(r)
+                # presence snapshot: nothing in this loop inserts into
+                # the dag, so one .tolist() per round-run turns the
+                # per-message VertexID dict probe into a C list index
+                # (PROFILE round 12: those probes were ~0.5s of the
+                # remaining 2.9s at n=256)
+                rr = r - base
+                exists_row = exists[rr].tolist() if rr < n_rows else None
+                seen_row = seen.get(r)
+                pool_row = cert_pool.get(r) if cert_on else None
+                pool_this = (
+                    cert_on and r % n != my_index and r not in cert_done
+                )
+            src = vid.source
             if (
-                vid in vertices
-                or (grp is not None and vid in grp)
+                (exists_row is not None and exists_row[src])
+                or (grp is not None and src in grp)
+                or (pool_row is not None and src in pool_row)
                 or (pending and vid in pending)
             ):
-                prev = seen.get(vid)
+                prev = seen_row[src] if seen_row is not None else None
                 if prev is not None and prev != v.digest():
                     metrics_inc("equivocations_detected")
                     self.log.event(
-                        "equivocation", round=r, source=vid.source
+                        "equivocation", round=r, source=src
                     )
                 else:
                     metrics_inc("msgs_duplicate")
@@ -471,17 +557,24 @@ class Process:
                     weak=len(v.weak_edges),
                 )
                 continue
-            seen[vid] = v.__dict__.get("_digest") or v.digest()
+            if seen_row is None:
+                seen_row = seen[r] = [None] * n
+            seen_row[src] = v.__dict__.get("_digest") or v.digest()
             if verifier is not None:
-                self._pending_verify.append(v)
-                pending.add(vid)
+                if pool_this:
+                    if pool_row is None:
+                        pool_row = cert_pool[r] = {}
+                    pool_row[src] = v
+                else:
+                    self._pending_verify.append(v)
+                    pending.add(vid)
             else:
                 if grp is None:
                     grp = groups[r] = {}
-                grp[vid] = v
+                grp[src] = v
                 cs = v.coin_share
                 if cs is not None and r % wave_len == 0:
-                    observe_share(r // wave_len, vid.source, cs)
+                    observe_share(r // wave_len, src, cs)
 
     def edges_valid(self, v: Vertex) -> bool:
         """The r_deliver admission gate: >= 2f+1 distinct strong edges
@@ -517,7 +610,7 @@ class Process:
 
     def _admit_to_buffer(self, v: Vertex) -> None:
         if self._vector:
-            self._buffer_rounds.setdefault(v.id.round, {})[v.id] = v
+            self._buffer_rounds.setdefault(v.id.round, {})[v.id.source] = v
         else:
             self._buffer.append(v)
             self._buffered_ids.add(v.id)
@@ -555,9 +648,22 @@ class Process:
         """Admit/reject a previously collected batch (apply half of the
         coalescing protocol; also the tail of :meth:`_drain_verify`)."""
         self.metrics.observe_verify_batch(len(batch), seconds)
+        cert = self._cert
+        n = self.cfg.n
         for v, good in zip(batch, ok):
             if good:
                 self._admit_to_buffer(v)
+                if (
+                    cert
+                    and v.cert_sig is not None
+                    and v.id.round % n == self.index
+                    and v.id.round not in self._certs_sent
+                ):
+                    # we are this round's designated aggregator: bank the
+                    # directly verified share for certificate assembly
+                    self._cert_stash.setdefault(v.id.round, {})[
+                        v.id.source
+                    ] = (v.digest(), v.cert_sig)
             else:
                 self.metrics.inc("msgs_rejected_signature")
                 self.log.event(
@@ -575,6 +681,155 @@ class Process:
         self.apply_verify_mask(batch, ok, t.seconds)
 
     # ------------------------------------------------------------------
+    # Aggregated round certificates (ISSUE 9)
+    # ------------------------------------------------------------------
+    # Round r's designated aggregator is process r % n. It verifies the
+    # round's vertices directly (the per-vertex oracle path), then sums
+    # the quorum's BLS shares into ONE certificate and gossips it; every
+    # other process parks round-r vertices in _cert_pool and admits them
+    # on one aggregate check instead of n signature verifies. A bad or
+    # missing certificate degrades that round back to per-vertex — the
+    # resilient.py ladder shape applied to the protocol layer.
+
+    def _note_seen(self, v: Vertex) -> None:
+        """Record ``v``'s digest in the per-round equivocation book."""
+        row = self._seen_digests.get(v.id.round)
+        if row is None:
+            row = self._seen_digests[v.id.round] = [None] * self.cfg.n
+        row[v.id.source] = v.digest()
+
+    def _on_certificate(self, msg: BroadcastMessage) -> None:
+        """Queue a received round certificate; application runs in
+        :meth:`step` after the deferred inbox drains, so a certificate
+        can never outrun the VALs it covers."""
+        cert = msg.cert
+        if not self._cert or cert is None:
+            self.metrics.inc("msgs_ignored_kind")
+            return
+        if (
+            cert.round < 1
+            or cert.round <= self.dag.base_round
+            or cert.round in self._cert_done
+        ):
+            self.metrics.inc("certs_ignored")
+            return
+        self._pending_certs.append(cert)
+        if self._started and not self.defer_steps:
+            self.step()
+
+    def _cert_step(self) -> bool:
+        """Apply queued certificates and assemble ours when a quorum of
+        directly verified shares is banked. Returns True when a
+        certificate admitted vertices (buffer progress)."""
+        progress = False
+        if self._pending_certs:
+            certs, self._pending_certs = self._pending_certs, []
+            for cert in certs:
+                progress |= self._apply_certificate(cert)
+        if self._cert_stash:
+            self._maybe_assemble_certs()
+        return progress
+
+    def _apply_certificate(self, cert: RoundCertificate) -> bool:
+        r = cert.round
+        if r <= self.dag.base_round or r in self._cert_done:
+            return False
+        if not self.cert_verifier.verify_certificate(cert):
+            # forged aggregate / bad bitmap / substituted digests: reject
+            # and fall back to per-vertex verifies for the whole round
+            self.metrics.inc("certs_rejected")
+            self.log.event("cert_reject", round=r)
+            self._degrade_cert_round(r)
+            return False
+        self.metrics.inc("certs_verified")
+        pool = self._cert_pool.pop(r, None) or {}
+        self._cert_done.add(r)
+        self._cert_wait.pop(r, None)
+        covered = dict(zip(cert.signers, cert.digests))
+        admitted = False
+        for src, v in pool.items():
+            d = covered.get(src)
+            if d is not None and d == (
+                v.__dict__.get("_digest") or v.digest()
+            ):
+                # certificate-admitted: enters the DAG through the
+                # trusted buffer/insert_many path, no per-vertex verify
+                self._admit_to_buffer(v)
+                self.metrics.inc("sigs_saved")
+                admitted = True
+            else:
+                # pooled copy the certificate doesn't vouch for — the
+                # per-vertex oracle decides
+                self._pending_verify.append(v)
+                self._pending_verify_ids.add(v.id)
+        return admitted
+
+    def _degrade_cert_round(self, r: int) -> None:
+        """agg -> per-vertex degradation rung: route the round's pooled
+        vertices through the normal verify queue. A Byzantine aggregator
+        costs a round its fast path, never its liveness."""
+        pool = self._cert_pool.pop(r, None)
+        self._cert_done.add(r)
+        self._cert_wait.pop(r, None)
+        self.metrics.inc("cert_rounds_degraded")
+        if pool:
+            for v in pool.values():
+                self._pending_verify.append(v)
+                self._pending_verify_ids.add(v.id)
+
+    def _cert_tick(self) -> bool:
+        """One patience tick for every round still waiting on its
+        certificate; expired rounds degrade. Returns True when anything
+        degraded (there is now per-vertex work to drain)."""
+        if not self._cert_pool:
+            return False
+        patience = self.cfg.cert_patience
+        timed_out = []
+        for r in self._cert_pool:
+            w = self._cert_wait.get(r, 0) + 1
+            self._cert_wait[r] = w
+            if w > patience:
+                timed_out.append(r)
+        for r in timed_out:
+            self.metrics.inc("cert_timeouts")
+            self.log.event("cert_timeout", round=r)
+            self._degrade_cert_round(r)
+        return bool(timed_out)
+
+    def _maybe_assemble_certs(self) -> None:
+        quorum = self.cfg.quorum
+        for r in sorted(self._cert_stash):
+            entries = self._cert_stash[r]
+            if len(entries) < quorum:
+                continue
+            del self._cert_stash[r]
+            if r in self._certs_sent:
+                continue
+            self._certs_sent.add(r)
+            cert = self.cert_verifier.make_certificate(
+                r, [(src, d, sig) for src, (d, sig) in entries.items()]
+            )
+            if cert is None:
+                continue
+            # Self-check before gossip: the shared verifier memoizes the
+            # verdict by certificate content, so in-process receivers'
+            # checks are dict hits — the cluster pays each aggregate
+            # pairing once (mirrors the simulator's dedup'd verify).
+            if not self.cert_verifier.verify_certificate(cert):
+                continue
+            self.metrics.inc("certs_assembled")
+            self.log.event("cert_assembled", round=r, signers=len(cert.signers))
+            self.transport.broadcast(
+                BroadcastMessage(
+                    vertex=None,
+                    round=r,
+                    sender=self.index,
+                    kind="cert",
+                    cert=cert,
+                )
+            )
+
+    # ------------------------------------------------------------------
     # The progress engine (Algorithm 2 lines 5-15)
     # ------------------------------------------------------------------
 
@@ -588,15 +843,23 @@ class Process:
         """
         made_progress = False
         progress = True
+        cert_ticked = False
         while progress:
             progress = False
             if self._inbox:
                 self._process_inbox()
+            if self._cert:
+                progress |= self._cert_step()
             self._drain_verify()
             progress |= self._drain_buffer()
             progress |= self._try_advance()
             progress |= self._retry_pending_waves()
             made_progress |= progress
+            if not progress and self._cert and not cert_ticked:
+                # one patience tick per step(), taken only at quiescence
+                # so a timeout-degraded round drains in THIS step
+                cert_ticked = True
+                progress |= self._cert_tick()
         self._maybe_request_sync(made_progress)
 
     def _drain_buffer(self) -> bool:
@@ -876,7 +1139,7 @@ class Process:
                         )
                 admitted_any = True
             if keep:
-                groups[r] = {v.id: v for v in keep}
+                groups[r] = {v.id.source: v for v in keep}
         return admitted_any
 
     def _try_advance(self) -> bool:
@@ -902,7 +1165,17 @@ class Process:
             self.log.event("round_advance", round=self.round)
             v = self._create_vertex(self.round)
             self.dag.insert(v)
-            self._seen_digests[v.id] = v.digest()
+            self._note_seen(v)
+            if (
+                self._cert
+                and v.cert_sig is not None
+                and self.round % self.cfg.n == self.index
+            ):
+                # our own proposal in a round we aggregate: bank the share
+                self._cert_stash.setdefault(self.round, {})[self.index] = (
+                    v.digest(),
+                    v.cert_sig,
+                )
             self._broadcast_vertex(v)
             self.metrics.inc("vertices_proposed")
             advanced = True
@@ -946,6 +1219,12 @@ class Process:
             weak_edges=weak,
             coin_share=share,
         )
+        if self._cert:
+            # BLS share over the digest (which excludes both signatures),
+            # attached before the ed25519 sign copies the fields forward
+            object.__setattr__(
+                v, "cert_sig", self.cert_signer.sign_digest(v.digest())
+            )
         if self.signer is not None:
             v = self.signer.sign_vertex(v)
         # Own proposals satisfy the admission gate by construction
@@ -1041,13 +1320,17 @@ class Process:
         # round-group dicts by vid instead — either emptiness check is
         # O(1), unlike the ``buffer`` property which flattens groups.
         waiting = (
-            bool(self._buffer_rounds)
-            if self._vector
-            else bool(self._buffered_ids)
-        ) or (
-            bool(self.blocks_to_propose)
-            and self.round >= 1
-            and self.dag.round_size(self.round) < self.cfg.quorum
+            (
+                bool(self._buffer_rounds)
+                if self._vector
+                else bool(self._buffered_ids)
+            )
+            or bool(self._cert_pool)  # rounds parked awaiting a cert
+            or (
+                bool(self.blocks_to_propose)
+                and self.round >= 1
+                and self.dag.round_size(self.round) < self.cfg.quorum
+            )
         )
         if self.cfg.sync_patience <= 0 or made_progress or not waiting:
             # any forward progress resets patience — a node that is being
@@ -1426,8 +1709,19 @@ class Process:
             self.delivered_trimmed += len(self.delivered_log) - len(keep)
             self.delivered_log = keep
         self._seen_digests = {
-            k: d for k, d in self._seen_digests.items() if k.round >= base
+            r: row for r, row in self._seen_digests.items() if r >= base
         }
+        if self._cert:
+            # Certificate books follow the same floor. Pooled vertices at
+            # or below it are retired history (unadmittable anyway).
+            for r in [r for r in self._cert_pool if r <= base]:
+                del self._cert_pool[r]
+                self._cert_wait.pop(r, None)
+            self._cert_stash = {
+                r: s for r, s in self._cert_stash.items() if r > base
+            }
+            self._cert_done = {r for r in self._cert_done if r > base}
+            self._certs_sent = {r for r in self._certs_sent if r > base}
         # A reliable-broadcast stage keeps per-slot vote books — retire
         # them along the same floor (transport/rbc.py prune_below), or a
         # long-running RBC node leaks exactly the state class the DAG

@@ -19,18 +19,33 @@ from __future__ import annotations
 import struct
 from typing import List, Optional, Sequence, Tuple
 
-from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.core.types import (
+    Block,
+    BroadcastMessage,
+    RoundCertificate,
+    Vertex,
+    VertexID,
+)
 
 _MAGIC = b"DRv1"
+#: v2 vertex: v1 plus a third optional blob (cert_sig). Emitted ONLY when
+#: cert_sig is present, so every cert-off vertex — and every byte already
+#: on disk in a checkpoint — stays exactly the DRv1 encoding.
+_MAGIC_V2 = b"DRv2"
 
 
 def encode_vertex(v: Vertex) -> bytes:
-    out = [_MAGIC, v.id.encode(), v.block.encode()]
+    v2 = v.cert_sig is not None
+    out = [_MAGIC_V2 if v2 else _MAGIC, v.id.encode(), v.block.encode()]
     for edges in (v.strong_edges, v.weak_edges):
         out.append(struct.pack("<I", len(edges)))
         for e in sorted(edges):
             out.append(e.encode())
-    for blob in (v.coin_share, v.signature):
+    blobs = (v.coin_share, v.signature, v.cert_sig) if v2 else (
+        v.coin_share,
+        v.signature,
+    )
+    for blob in blobs:
         if blob is None:
             out.append(struct.pack("<i", -1))
         else:
@@ -40,7 +55,12 @@ def encode_vertex(v: Vertex) -> bytes:
 
 
 def decode_vertex(data: bytes, offset: int = 0) -> Tuple[Vertex, int]:
-    if data[offset : offset + 4] != _MAGIC:
+    magic = data[offset : offset + 4]
+    if magic == _MAGIC:
+        nblobs = 2
+    elif magic == _MAGIC_V2:
+        nblobs = 3
+    else:
         raise ValueError("bad vertex magic")
     offset += 4
     rnd, source = struct.unpack_from("<II", data, offset)
@@ -57,7 +77,7 @@ def decode_vertex(data: bytes, offset: int = 0) -> Tuple[Vertex, int]:
             edges.append(VertexID(er, es))
         edge_sets.append(tuple(edges))
     blobs = []
-    for _ in range(2):
+    for _ in range(nblobs):
         (ln,) = struct.unpack_from("<i", data, offset)
         offset += 4
         if ln < 0:
@@ -72,11 +92,57 @@ def decode_vertex(data: bytes, offset: int = 0) -> Tuple[Vertex, int]:
         weak_edges=edge_sets[1],
         coin_share=blobs[0],
         signature=blobs[1],
+        cert_sig=blobs[2] if nblobs == 3 else None,
     )
     return v, offset
 
 
-_KINDS = ("val", "echo", "ready", "fetch", "sync", "sync_nack")
+def encode_certificate(cert: RoundCertificate) -> bytes:
+    """Certificate layout: round, signer count, signer u32s, the parallel
+    digest blobs (u32 length-prefixed), then the aggregate signature."""
+    out = [
+        struct.pack("<II", cert.round, len(cert.signers)),
+        struct.pack(f"<{len(cert.signers)}I", *cert.signers)
+        if cert.signers
+        else b"",
+    ]
+    for d in cert.digests:
+        out.append(struct.pack("<I", len(d)))
+        out.append(d)
+    out.append(struct.pack("<I", len(cert.agg_sig)))
+    out.append(cert.agg_sig)
+    return b"".join(out)
+
+
+def decode_certificate(
+    data: bytes, offset: int = 0
+) -> Tuple[RoundCertificate, int]:
+    rnd, count = struct.unpack_from("<II", data, offset)
+    offset += 8
+    signers = struct.unpack_from(f"<{count}I", data, offset)
+    offset += 4 * count
+    digests = []
+    for _ in range(count):
+        (ln,) = struct.unpack_from("<I", data, offset)
+        offset += 4
+        digests.append(data[offset : offset + ln])
+        offset += ln
+    (ln,) = struct.unpack_from("<I", data, offset)
+    offset += 4
+    agg = data[offset : offset + ln]
+    offset += ln
+    return (
+        RoundCertificate(
+            round=rnd,
+            signers=tuple(signers),
+            digests=tuple(digests),
+            agg_sig=agg,
+        ),
+        offset,
+    )
+
+
+_KINDS = ("val", "echo", "ready", "fetch", "sync", "sync_nack", "cert")
 
 
 def encode_message(msg: BroadcastMessage) -> bytes:
@@ -96,6 +162,14 @@ def encode_message(msg: BroadcastMessage) -> bytes:
     else:
         out.append(b"\x01")
         out.append(encode_vertex(msg.vertex))
+    # certificate section only for the cert kind: every pre-existing
+    # message kind keeps its exact byte layout
+    if msg.kind == "cert":
+        if msg.cert is None:
+            out.append(b"\x00")
+        else:
+            out.append(b"\x01")
+            out.append(encode_certificate(msg.cert))
     return b"".join(out)
 
 
@@ -115,14 +189,22 @@ def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]
     v = None
     if has_vertex:
         v, offset = decode_vertex(data, offset)
+    kind = _KINDS[kind_code]
+    cert = None
+    if kind == "cert":
+        has_cert = data[offset]
+        offset += 1
+        if has_cert:
+            cert, offset = decode_certificate(data, offset)
     return (
         BroadcastMessage(
             vertex=v,
             round=rnd,
             sender=sender,
-            kind=_KINDS[kind_code],
+            kind=kind,
             origin=None if origin < 0 else origin,
             digest=digest,
+            cert=cert,
         ),
         offset,
     )

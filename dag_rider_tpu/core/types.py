@@ -100,6 +100,12 @@ class Vertex:
     weak_edges: Tuple[VertexID, ...] = ()
     signature: Optional[bytes] = None
     coin_share: Optional[bytes] = None
+    #: BLS signature over digest() for the aggregated round-certificate
+    #: path (ISSUE 9). Like ``signature``, an attestation OF the content
+    #: — excluded from signing_bytes/digest (both enumerate fields
+    #: explicitly), so attaching it never perturbs the vertex identity
+    #: the per-vertex oracle path verifies.
+    cert_sig: Optional[bytes] = None
 
     @property
     def round(self) -> int:
@@ -177,6 +183,32 @@ class Vertex:
 
 
 @dataclasses.dataclass(frozen=True)
+class RoundCertificate:
+    """One aggregated attestation for a whole DAG round (ISSUE 9).
+
+    Assembled by the round's designated aggregator once it has directly
+    verified a quorum of the round's vertices: ``signers`` lists the
+    source indices covered (sorted, >= 2f+1 of them), ``digests`` the
+    matching vertex digests (parallel to ``signers``), and ``agg_sig``
+    the compressed G1 sum of the per-vertex BLS ``cert_sig`` values.
+    A receiver checks the whole round with ONE aggregate pairing —
+    e(agg, -G2) * prod e(H(digest_i), pk_i) == 1 — instead of one
+    ed25519 verify per vertex.
+    """
+
+    round: int
+    signers: Tuple[int, ...]
+    digests: Tuple[bytes, ...]
+    agg_sig: bytes
+
+    def signing_key(self) -> tuple:
+        """Hashable identity of what the certificate claims — the memo
+        key for sharing one verification verdict across an in-process
+        cluster (the registry identity is added by the verifier)."""
+        return (self.round, self.signers, self.digests, self.agg_sig)
+
+
+@dataclasses.dataclass(frozen=True)
 class BroadcastMessage:
     """The unit the Transport carries (reference ``bcastMsg``,
     ``process/transport.go:11-18``): a vertex plus the round/sender stamps.
@@ -198,3 +230,5 @@ class BroadcastMessage:
     kind: str = "val"
     origin: Optional[int] = None
     digest: Optional[bytes] = None
+    #: aggregated round certificate, only for kind == "cert" (ISSUE 9)
+    cert: Optional[RoundCertificate] = None

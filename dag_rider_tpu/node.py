@@ -33,6 +33,12 @@ Config (JSON):
                                    // (default DAGRIDER_VERIFY_RETRY)
   "coin": "threshold_bls",         // | "round_robin" | "fixed"
   "coin_msm": "host",              // "device": share aggregation on the mesh
+  "cert": "agg",                   // aggregated round certificates (ISSUE 9):
+                                   // one BLS aggregate check admits a whole
+                                   // round; default "off" (per-vertex path);
+                                   // env default DAGRIDER_CERT
+  "cert_msm": "host",              // | "device" | "sharded" — certificate
+                                   // aggregation seam (DAGRIDER_CERT_MSM)
 
   "checkpoint_dir": "ckpt/node0",  // optional, periodic + on shutdown
   "checkpoint_every_s": 30,
@@ -99,6 +105,21 @@ def generate_keys(
     coin_keys = th.ThresholdKeys.generate(n, threshold, seed=seed.encode())
     from dag_rider_tpu.crypto import bls12381 as bls
 
+    # per-node BLS certificate keys (ISSUE 9 aggregated round
+    # certificates) — distinct from the threshold-coin shares: cert
+    # signatures are independent per node, never Shamir-combined
+    import hashlib
+
+    cert_sks = [
+        int.from_bytes(
+            hashlib.sha256(
+                seed.encode() + b"|cert|" + str(i).encode()
+            ).digest(),
+            "big",
+        )
+        % bls.R
+        for i in range(n)
+    ]
     return {
         "n": n,
         "threshold": threshold,
@@ -109,6 +130,10 @@ def generate_keys(
             bls.g2_serialize(pk).hex() for pk in coin_keys.share_pks
         ],
         "bls_share_sks": [hex(sk) for sk in coin_keys.share_sks],
+        "bls_cert_pks": [
+            bls.g2_serialize(bls.pk_of(sk)).hex() for sk in cert_sks
+        ],
+        "bls_cert_sks": [hex(sk) for sk in cert_sks],
     }
 
 
@@ -141,6 +166,18 @@ def load_keys(blob: dict):
         # elsewhere) — the dealerless property
         [int(sk, 16) if sk else None for sk in blob["bls_share_sks"]],
     )
+    if blob.get("bls_cert_pks"):
+        # certificate PKI rides the same registry (ISSUE 9); older key
+        # files without it simply leave the cert path gated off
+        import dataclasses
+
+        reg = dataclasses.replace(
+            reg,
+            bls_public_keys=tuple(
+                bls.g2_deserialize(bytes.fromhex(p))
+                for p in blob["bls_cert_pks"]
+            ),
+        )
     return reg, seeds, coin_keys
 
 
@@ -164,9 +201,12 @@ class Node:
             gc_depth=int(gc_depth) if gc_depth is not None else None,
             # hot-path pump flavor; None defers to DAGRIDER_PUMP / scalar
             pump=cfg.get("pump"),
+            # aggregated round certificates; None defers to DAGRIDER_CERT
+            cert=cfg.get("cert"),
         )
         with open(cfg["keys"]) as fh:
-            reg, seeds, coin_keys = load_keys(json.load(fh))
+            keyblob = json.load(fh)
+        reg, seeds, coin_keys = load_keys(keyblob)
         if reg.n != n:
             raise ValueError(f"keys are for n={reg.n}, config says n={n}")
 
@@ -329,6 +369,35 @@ class Node:
         elif self.ccfg.coin == "round_robin":
             coin = RoundRobinCoin(n)
 
+        cert_signer = cert_verifier = None
+        if self.ccfg.cert == "agg":
+            # aggregated round certificates (ISSUE 9): needs the cert PKI
+            # in the key file AND a verifier (the aggregator tier still
+            # verifies its own rounds per-vertex)
+            if verifier is None:
+                raise ValueError('cert "agg" needs a verifier (not "none")')
+            if not reg.bls_public_keys:
+                raise ValueError(
+                    'cert "agg" needs bls_cert_pks in the key file '
+                    "(re-run keygen)"
+                )
+            sk_hex = (keyblob.get("bls_cert_sks") or [None] * n)[index]
+            if not sk_hex:
+                raise ValueError(
+                    'cert "agg" needs this node\'s bls_cert_sks entry'
+                )
+            from dag_rider_tpu.verifier.base import CertSigner
+            from dag_rider_tpu.verifier.cert import CertVerifier
+
+            cert_signer = CertSigner(int(sk_hex, 16))
+            cert_verifier = CertVerifier(
+                reg, self.ccfg.quorum, msm=cfg.get("cert_msm")
+            )
+            if hasattr(verifier, "cert_verifier"):
+                # ladder deployments surface the certificate gauges in
+                # the same resilience bundle (verifier/resilient.py)
+                verifier.cert_verifier = cert_verifier
+
         self.delivered = []
         self.mempool = None
         self.process = Process(
@@ -338,6 +407,8 @@ class Node:
             coin=coin,
             verifier=verifier,
             signer=VertexSigner(seeds[index]),
+            cert_signer=cert_signer,
+            cert_verifier=cert_verifier,
             on_deliver=self._on_deliver,
             log=self.log,
         )
@@ -657,6 +728,10 @@ def main(argv=None) -> int:
                     sk if j == i else None
                     for j, sk in enumerate(blob["bls_share_sks"])
                 ]
+                per["bls_cert_sks"] = [
+                    sk if j == i else None
+                    for j, sk in enumerate(blob["bls_cert_sks"])
+                ]
                 path = os.path.join(
                     args.per_node_dir, f"node{i}-identity.json"
                 )
@@ -728,6 +803,12 @@ def main(argv=None) -> int:
         out["bls_share_sks"] = [
             hex(res.share_sk) if i == args.index else None for i in range(n)
         ]
+        if out.get("bls_cert_sks"):
+            # same dealerless scrub for the certificate secrets
+            out["bls_cert_sks"] = [
+                sk if i == args.index else None
+                for i, sk in enumerate(out["bls_cert_sks"])
+            ]
         out["dkg_qualified"] = list(res.qualified)
         _dump_secret_file(args.out, out)
         print(

@@ -341,3 +341,10 @@ def msm(scalars: Sequence[int], points: Sequence[tuple]) -> Optional[tuple]:
         impl=msm_impl(t),
     )
     return unpack_point(X, Y, Z)
+
+
+def sum_points(points: Sequence[tuple]) -> Optional[tuple]:
+    """Plain G1 point sum as an all-ones MSM — the device half of
+    certificate signature aggregation (ISSUE 9). Same input/output
+    conventions as :func:`msm`."""
+    return msm([1] * len(points), points)

@@ -91,3 +91,8 @@ class ShardedMSM:
             jnp.asarray(nib), jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)
         )
         return bls_msm.unpack_point(X, Y, Z)
+
+    def sum_points(self, points: Sequence[tuple]) -> Optional[tuple]:
+        """All-ones MSM — mesh-sharded certificate signature aggregation
+        (ISSUE 9), mirroring :func:`ops.bls_msm.sum_points`."""
+        return self([1] * len(points), points)

@@ -186,7 +186,20 @@ class FaultyTransport(Transport):
         self._held: List[tuple] = []
         self._seq = 0
         self._handlers: Dict[int, Handler] = {}
+        self._batch_handlers: Dict[int, Callable] = {}
         self._mutator: Optional[Callable[[Vertex], Vertex]] = None
+        # Grouped-pump passthrough (round 13): installed as an INSTANCE
+        # attribute only for delay-free, topology-free plans, so the
+        # Simulation's `callable(pump_grouped)` probe silently falls back
+        # to per-message pumping whenever a roll could hold a message
+        # back — delayed entries need their per-message handler captured,
+        # which only the scalar path records.
+        if (
+            topology is None
+            and plan.delay == 0.0
+            and callable(getattr(self.inner, "pump_grouped", None))
+        ):
+            self.pump_grouped = self._pump_grouped
 
     def set_equivocation_mutator(self, fn: Callable[[Vertex], Vertex]) -> None:
         """How to corrupt an equivocator's vertex (defaults to payload swap)."""
@@ -199,6 +212,28 @@ class FaultyTransport(Transport):
             self._deliver(index, handler, msg)
 
         self.inner.subscribe(index, wrapped)
+
+    def subscribe_many(
+        self, index: int, handler: Callable[[list], None]
+    ) -> None:
+        """Register a batch handler: the inner's grouped pump hands VAL
+        runs to a wrapper that pays the SAME per-message roll structure
+        as :meth:`_deliver` (equivocation coin, one main roll, duplicate
+        roll on delivery) and forwards the survivors as one batch call.
+        Grouping permutes delivery order across destinations, so the
+        roll->(message, destination) assignment differs from the scalar
+        pump for the same seed — fault RATES are identical, seed-pinned
+        schedules are per-pump. Falls back silently when the inner has
+        no batch seam (the per-message path stays correct on its own)."""
+        self._batch_handlers[index] = handler
+        sub_many = getattr(self.inner, "subscribe_many", None)
+        if not callable(sub_many):
+            return
+
+        def wrapped(msgs: list) -> None:
+            self._deliver_batch(index, handler, msgs)
+
+        sub_many(index, wrapped)
 
     def broadcast(self, msg: BroadcastMessage) -> None:
         self.inner.broadcast(msg)
@@ -232,6 +267,46 @@ class FaultyTransport(Transport):
         handler(out)
         if self.rng.random() < self.plan.duplicate:
             self.stats["duplicated"] += 1
+            handler(out)
+
+    def _deliver_batch(
+        self, dest: int, handler: Callable[[list], None], msgs: list
+    ) -> None:
+        """A VAL run for one destination through the plan, message by
+        message: drops leave the batch, duplicates appear twice, an
+        equivocation coin may substitute a conflicting vertex. Survivors
+        go out as ONE batch call. A delay roll (possible only when the
+        inner's grouped pump is driven directly — the Simulation never
+        selects it for delay plans) parks the message with its
+        per-message handler so flush_delayed replays it unchanged."""
+        plan = self.plan
+        rng = self.rng
+        stats = self.stats
+        out: list = []
+        for msg in msgs:
+            m = msg
+            if (
+                msg.vertex is not None
+                and msg.sender in plan.equivocators
+                and rng.random() < 0.5
+            ):
+                m = dataclasses.replace(
+                    msg, vertex=self._equivocate(msg.vertex)
+                )
+                stats["equivocated"] += 1
+            roll = rng.random()
+            if roll < plan.drop:
+                stats["dropped"] += 1
+                continue
+            if roll < plan.drop + plan.delay:
+                stats["delayed"] += 1
+                self.delayed.append((dest, self._handlers[dest], m))
+                continue
+            out.append(m)
+            if rng.random() < plan.duplicate:
+                stats["duplicated"] += 1
+                out.append(m)
+        if out:
             handler(out)
 
     def _deliver_wan(
@@ -319,6 +394,26 @@ class FaultyTransport(Transport):
     def pump(self, max_messages: Optional[int] = None) -> int:
         fn = getattr(self.inner, "pump", None)
         return int(fn(max_messages)) if callable(fn) else 0
+
+    def _pump_grouped(self, max_messages: Optional[int] = None) -> int:
+        """Bound to ``self.pump_grouped`` in ``__init__`` for delay-free,
+        topology-free plans only: VAL runs reach :meth:`_deliver_batch`
+        through the inner's batch seam, everything else flows through
+        the per-message wrappers exactly as under :meth:`pump`."""
+        return int(self.inner.pump_grouped(max_messages))
+
+    @property
+    def fanout_sentinel(self) -> bool:
+        """Forwarded to the inner transport: fan-out expansion happens
+        where the queue lives, and this wrapper intercepts at delivery
+        time — after expansion — so sentinel compression is invisible to
+        the fault rolls."""
+        return bool(getattr(self.inner, "fanout_sentinel", False))
+
+    @fanout_sentinel.setter
+    def fanout_sentinel(self, value: bool) -> None:
+        if hasattr(self.inner, "fanout_sentinel"):
+            self.inner.fanout_sentinel = bool(value)
 
     @property
     def pending(self) -> int:

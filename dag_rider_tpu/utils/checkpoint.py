@@ -139,7 +139,7 @@ def restore(process, path: str, *, mempool=None) -> None:
             continue
         process.dag.insert(v)
         if v.round >= 1:
-            process._seen_digests[v.id] = v.digest()
+            process._note_seen(v)
             process._observe_coin_share(v)
     for v in buffered:
         if not process.edges_valid(v):
@@ -148,7 +148,7 @@ def restore(process, path: str, *, mempool=None) -> None:
             )
             continue
         process._admit_to_buffer(v)
-        process._seen_digests[v.id] = v.digest()
+        process._note_seen(v)
     process.round = manifest["round"]
     process.decided_wave = manifest["decided_wave"]
     process._waves_tried = set(manifest["waves_tried"])
@@ -387,7 +387,9 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     process._pending_verify = []
     process._pending_verify_ids = set()
     process._stuck_steps = 0
-    process._seen_digests = {v.id: v.digest() for v in accepted}
+    process._seen_digests = {}
+    for v in accepted:
+        process._note_seen(v)
     for v in accepted:
         process._observe_coin_share(v)
     process.round = top

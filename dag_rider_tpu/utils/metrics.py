@@ -342,6 +342,24 @@ class Metrics:
                     out["host_pump_ms_per_round"] = round(
                         1e3 * self.pump_seconds_total / rounds, 3
                     )
+        if "cert_path_enabled" in self.counters:
+            # aggregated round-certificate gauges (ISSUE 9): the cert
+            # counters are part of the stable schema whenever the fast
+            # path is wired — "0 certs" must be distinguishable from
+            # "cert path absent"
+            for k in (
+                "certs_assembled",
+                "certs_verified",
+                "certs_rejected",
+                "cert_timeouts",
+                "cert_rounds_degraded",
+                "sigs_saved",
+            ):
+                out.setdefault(k, 0)
+            admitted = self.counters.get("vertices_admitted", 0)
+            out["cert_fastpath_fraction"] = round(
+                self.counters.get("sigs_saved", 0) / admitted, 4
+            ) if admitted else 0.0
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)
         if self.wave_interval_seconds:

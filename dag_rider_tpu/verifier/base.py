@@ -59,6 +59,47 @@ class KeyRegistry:
     def n(self) -> int:
         return len(self.public_keys)
 
+    #: source index -> BLS12-381 G2 public key (affine fp2 tuple) for the
+    #: aggregated round-certificate path (ISSUE 9). Empty when the
+    #: deployment has no certificate keys — everything cert-related gates
+    #: on this being populated.
+    bls_public_keys: tuple = ()
+
+    def bls_key_of(self, source: int):
+        """BLS certificate public key of ``source`` — total, like
+        :meth:`key_of`."""
+        if not 0 <= source < len(self.bls_public_keys):
+            return None
+        return self.bls_public_keys[source]
+
+    @staticmethod
+    def generate_with_cert(
+        n: int, seed_prefix: bytes = b"dagrider-test-key-"
+    ):
+        """The :meth:`generate` test PKI plus per-process BLS certificate
+        keys. Returns (registry, ed25519 seeds, bls secret keys); the
+        BLS secrets are what :class:`CertSigner` wraps."""
+        import hashlib
+
+        from dag_rider_tpu.crypto import bls12381 as bls
+
+        reg, seeds = KeyRegistry.generate(n, seed_prefix)
+        sks, pks = [], []
+        for i in range(n):
+            sk = (
+                int.from_bytes(
+                    hashlib.sha256(
+                        seed_prefix + b"|bls|" + str(i).encode()
+                    ).digest(),
+                    "big",
+                )
+                % bls.R
+            )
+            sks.append(sk)
+            pks.append(bls.pk_of(sk))
+        reg = dataclasses.replace(reg, bls_public_keys=tuple(pks))
+        return reg, seeds, sks
+
 
 class VertexSigner:
     """Signs this process's own vertices (held by the Process). The key
@@ -77,6 +118,21 @@ class VertexSigner:
             self._a, self._prefix, self._A_enc, v.signing_bytes()
         )
         return dataclasses.replace(v, signature=sig)
+
+
+class CertSigner:
+    """BLS-signs this process's own vertex digests for the aggregated
+    round-certificate path (ISSUE 9). Separate from :class:`VertexSigner`
+    on purpose: the ed25519 vertex signature stays the per-vertex oracle;
+    the BLS signature only ever feeds certificate aggregation."""
+
+    def __init__(self, sk: int):
+        self._sk = sk
+
+    def sign_digest(self, digest: bytes) -> bytes:
+        from dag_rider_tpu.crypto import bls12381 as bls
+
+        return bls.sign(self._sk, digest)
 
 
 class VerifierUnavailableError(RuntimeError):

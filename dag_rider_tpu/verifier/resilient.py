@@ -114,6 +114,12 @@ class ResilientVerifier(Verifier):
         self.fallbacks_total = 0
         self.exhausted_total = 0  # batches rejected by the WHOLE ladder
         self.last_tier = 0
+        #: optional CertVerifier (ISSUE 9): the aggregated-certificate
+        #: check is a rung ABOVE this ladder — a bad certificate degrades
+        #: the round back onto the per-vertex tiers below (the Process
+        #: owns that transition); wiring the CertVerifier here folds its
+        #: accept/reject gauges into the same resilience bundle.
+        self.cert_verifier = None
         # a poisoned pipeline window re-verifies its quarantined chunk on
         # the ladder's NEXT tier (see module docstring)
         for i, tier in enumerate(self.tiers):
@@ -265,7 +271,7 @@ class ResilientVerifier(Verifier):
                 rpc_failures += rpc
                 if sidecar_health is None:
                     sidecar_health = 1 if health[i] else 0
-        return {
+        out = {
             "retries": retries,
             "fallback_tier": self.last_tier,
             "fallbacks": self.fallbacks_total,
@@ -277,3 +283,9 @@ class ResilientVerifier(Verifier):
             "sidecar_health": sidecar_health,
             "tier_health": [1 if h else 0 for h in health],
         }
+        if self.cert_verifier is not None:
+            cs = self.cert_verifier.stats
+            out["cert_checks"] = cs["certs_checked"]
+            out["cert_invalid"] = cs["certs_invalid"]
+            out["cert_verdict_hits"] = cs["verdict_hits"]
+        return out

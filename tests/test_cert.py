@@ -1,0 +1,401 @@
+"""Aggregated round certificates (ISSUE 9 / round 13).
+
+The certificate fast path is an EXECUTION STRATEGY for the verify seam,
+not a protocol change: a round admitted through one aggregate BLS check
+must produce the exact delivery log the per-vertex oracle produces, and
+a Byzantine aggregator must cost a round its fast path, never its
+liveness or its safety. This suite pins that four ways:
+
+- crypto: ``multi_pairing_check`` agrees with the reference
+  ``pairing_check`` on accept AND reject; the device/host MSM seams sum
+  to the same point;
+- unit: CertVerifier assembly/verification roundtrips, verdict
+  memoization, and rejection of every crafted defect — bad bitmap,
+  forged aggregate, substituted digests, malformed points;
+- wire: DRv1 stays byte-stable for cert-less vertices, DRv2 carries the
+  share, certificates roundtrip alone and inside "cert" messages;
+- end-to-end: cert-on and cert-off paired runs deliver byte-identical
+  logs (ids + digests) across committee sizes and both pump flavors,
+  with the signature-op books showing the saved verifies; injected bad
+  certificates and a silent aggregator degrade the round onto the
+  per-vertex ladder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core import codec
+from dag_rider_tpu.core.types import (
+    Block,
+    BroadcastMessage,
+    RoundCertificate,
+    Vertex,
+    VertexID,
+)
+from dag_rider_tpu.crypto import bls12381 as bls
+from dag_rider_tpu.verifier.base import CertSigner, KeyRegistry
+from dag_rider_tpu.verifier.cert import CertVerifier
+
+# ---------------------------------------------------------------------------
+# knob plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_cert_defaults_off(monkeypatch):
+    monkeypatch.delenv("DAGRIDER_CERT", raising=False)
+    assert Config(n=4).cert == "off"
+
+
+def test_cert_env_resolution(monkeypatch):
+    monkeypatch.setenv("DAGRIDER_CERT", "agg")
+    assert Config(n=4).cert == "agg"
+
+
+def test_cert_explicit_beats_env(monkeypatch):
+    monkeypatch.setenv("DAGRIDER_CERT", "agg")
+    assert Config(n=4, cert="off").cert == "off"
+
+
+def test_cert_validation():
+    with pytest.raises(ValueError):
+        Config(n=4, cert="maybe")
+    with pytest.raises(ValueError):
+        Config(n=4, cert_patience=0)
+
+
+# ---------------------------------------------------------------------------
+# crypto pins
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cert_keys():
+    reg, _seeds, sks = KeyRegistry.generate_with_cert(4)
+    return reg, sks
+
+
+def _entries(sks, digests):
+    return [
+        (i, d, CertSigner(sk).sign_digest(d))
+        for i, (sk, d) in enumerate(zip(sks, digests))
+    ]
+
+
+def _digests(tag: bytes, k: int = 4):
+    return [bytes([i]) * 16 + tag.ljust(16, b".") for i in range(k)]
+
+
+def test_multi_pairing_check_matches_reference(cert_keys):
+    reg, sks = cert_keys
+    digests = _digests(b"mpc")
+    sigs = [bls.sign(sk, d) for sk, d in zip(sks, digests)]
+    agg = bls.g1_sum([bls.g1_decompress(s) for s in sigs])
+    pairs = [(agg, bls.g2_neg(bls.G2_GEN))] + [
+        (bls.hash_to_g1(d), reg.bls_key_of(i))
+        for i, d in enumerate(digests)
+    ]
+    assert bls.multi_pairing_check(pairs) is True
+    assert bls.pairing_check(pairs) is True
+    # one substituted message flips BOTH checks the same way
+    bad = list(pairs)
+    bad[1] = (bls.hash_to_g1(b"not-what-was-signed"), reg.bls_key_of(0))
+    assert bls.multi_pairing_check(bad) is False
+    assert bls.pairing_check(bad) is False
+
+
+def test_msm_seams_agree_on_aggregate():
+    from dag_rider_tpu.ops import bls_msm
+
+    pts = [bls.g1_mul(k + 3) for k in range(5)]
+    host = bls.g1_sum(pts)
+    assert bls_msm.sum_points(pts) == host
+    assert bls.g1_compress(host) == bls.g1_compress(
+        bls_msm.sum_points(list(reversed(pts)))
+    )
+
+
+# ---------------------------------------------------------------------------
+# CertVerifier unit
+# ---------------------------------------------------------------------------
+
+
+def test_certificate_roundtrip_and_memoization(cert_keys):
+    reg, sks = cert_keys
+    cv = CertVerifier(reg, quorum=3)
+    digests = _digests(b"rt")
+    cert = cv.make_certificate(7, _entries(sks, digests)[:3])
+    assert cert is not None and cert.round == 7
+    assert cert.signers == (0, 1, 2)
+    assert cv.verify_certificate(cert) is True
+    assert cv.stats["certs_valid"] == 1
+    # in-process sharing: the second ask is a dict hit, not a pairing
+    assert cv.verify_certificate(cert) is True
+    assert cv.stats["verdict_hits"] == 1
+    assert cv.stats["certs_checked"] == 2
+
+
+def test_certificate_below_quorum_refused(cert_keys):
+    reg, sks = cert_keys
+    cv = CertVerifier(reg, quorum=3)
+    assert cv.make_certificate(1, _entries(sks, _digests(b"q"))[:2]) is None
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        # bad bitmap: claims a signer that never signed (share swapped
+        # onto another index)
+        lambda c: dataclasses.replace(c, signers=(0, 1, 3)),
+        # bad bitmap: structurally broken lists
+        lambda c: dataclasses.replace(c, signers=(0, 1, 1)),
+        lambda c: dataclasses.replace(c, signers=(0, 1, 9)),
+        lambda c: dataclasses.replace(c, signers=(0, 1)),
+        # stale digests: one vertex substituted after aggregation
+        lambda c: dataclasses.replace(
+            c, digests=(c.digests[0], b"stale-digest!".ljust(32, b"?"), c.digests[2])
+        ),
+        # forged aggregate: a valid G1 point nobody's shares sum to
+        lambda c: dataclasses.replace(
+            c, agg_sig=bls.g1_compress(bls.g1_mul(0xBAD))
+        ),
+        # malformed aggregate bytes
+        lambda c: dataclasses.replace(c, agg_sig=b"\xff" * 48),
+    ],
+)
+def test_byzantine_certificate_always_detected(cert_keys, mutate):
+    reg, sks = cert_keys
+    cv = CertVerifier(reg, quorum=3)
+    cert = cv.make_certificate(3, _entries(sks, _digests(b"byz"))[:3])
+    assert cv.verify_certificate(cert) is True
+    forged = mutate(cert)
+    assert cv.verify_certificate(forged) is False
+    assert cv.stats["certs_invalid"] == 1
+    # a defect never raises and never poisons the good verdict
+    assert cv.verify_certificate(cert) is True
+
+
+def test_cert_verifier_requires_bls_registry():
+    reg, _ = KeyRegistry.generate(4)
+    with pytest.raises(ValueError):
+        CertVerifier(reg, quorum=3)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+def _vertex(rnd=2, src=1, cert_sig=None):
+    return Vertex(
+        id=VertexID(rnd, src),
+        block=Block((b"blk",)),
+        strong_edges=tuple(VertexID(rnd - 1, s) for s in range(3)),
+        cert_sig=cert_sig,
+    )
+
+
+def test_vertex_codec_stays_drv1_without_share():
+    blob = codec.encode_vertex(_vertex())
+    assert blob.startswith(b"DRv1")
+    v, _ = codec.decode_vertex(blob)
+    assert v.cert_sig is None and v.digest() == _vertex().digest()
+
+
+def test_vertex_codec_drv2_carries_share(cert_keys):
+    _, sks = cert_keys
+    sig = CertSigner(sks[0]).sign_digest(_vertex().digest())
+    v = _vertex(cert_sig=sig)
+    blob = codec.encode_vertex(v)
+    assert blob.startswith(b"DRv2")
+    out, _ = codec.decode_vertex(blob)
+    assert out.cert_sig == sig
+    # the share rides OUTSIDE the signed bytes: digests agree across
+    # wire forms, so cert-on and cert-off clusters hash identically
+    assert out.digest() == _vertex().digest()
+
+
+def test_certificate_and_cert_message_roundtrip(cert_keys):
+    reg, sks = cert_keys
+    cv = CertVerifier(reg, quorum=3)
+    cert = cv.make_certificate(5, _entries(sks, _digests(b"wire"))[:3])
+    out, _ = codec.decode_certificate(codec.encode_certificate(cert))
+    assert out == cert
+    msg = BroadcastMessage(
+        vertex=None, round=5, sender=1, kind="cert", cert=cert
+    )
+    got = codec.decode_message(codec.encode_message(msg))[0]
+    assert got.kind == "cert" and got.cert == cert
+    batch = codec.decode_many(codec.encode_many([msg]))
+    assert batch[0].cert == cert
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: agg == per-vertex, byte for byte
+# ---------------------------------------------------------------------------
+
+
+def _run_cert(n, seed, *, cert, pump="vector", blocks=6):
+    cfg = Config(
+        n=n, coin="round_robin", propose_empty=False, pump=pump
+    )
+    sim = Simulation(cfg, verifier="cpu", cert=cert)
+    for i in range(n):
+        for k in range(blocks):
+            sim.processes[i].submit(
+                Block((f"s{seed}-p{i}-b{k}".encode().ljust(32, b"."),))
+            )
+    sim.run(max_messages=400_000)
+    sim.check_agreement()
+    logs = [
+        [(v.id, v.digest()) for v in sim.deliveries[i]] for i in range(n)
+    ]
+    return logs, sim
+
+
+@pytest.mark.parametrize(
+    "n,seed",
+    [(4, 0), (4, 1), (16, 0), pytest.param(32, 0, marks=pytest.mark.slow)],
+)
+def test_agg_commit_order_identical(n, seed):
+    """Acceptance: certificate-admitted rounds commit the exact log the
+    per-vertex oracle commits — same ids, same digests, same order —
+    while the cluster verifies a fraction of the signatures."""
+    agg_logs, agg_sim = _run_cert(n, seed, cert=True)
+    ref_logs, ref_sim = _run_cert(n, seed, cert=False)
+    assert any(agg_logs)
+    assert agg_logs == ref_logs
+    snaps = [p.metrics.snapshot() for p in agg_sim.processes]
+    assert sum(s.get("certs_assembled", 0) for s in snaps) > 0
+    assert sum(s.get("sigs_saved", 0) for s in snaps) > 0
+    assert all(s.get("certs_rejected", 0) == 0 for s in snaps)
+    agg_sigs = sum(s.get("verify_sigs_total", 0) for s in snaps)
+    ref_sigs = sum(
+        p.metrics.snapshot().get("verify_sigs_total", 0)
+        for p in ref_sim.processes
+    )
+    assert agg_sigs < ref_sigs
+    # cert-off snapshots stay free of cert gauges
+    off = ref_sim.processes[0].metrics.snapshot()
+    assert "cert_fastpath_fraction" not in off
+
+
+def test_agg_equivalent_under_scalar_pump():
+    agg_logs, _ = _run_cert(4, 2, cert=True, pump="scalar")
+    ref_logs, _ = _run_cert(4, 2, cert=False, pump="scalar")
+    assert any(agg_logs)
+    assert agg_logs == ref_logs
+
+
+def test_cert_fastpath_gauges_surface():
+    _, sim = _run_cert(4, 3, cert=True)
+    snap = sim.processes[1].metrics.snapshot()
+    for key in (
+        "certs_verified",
+        "cert_fastpath_fraction",
+        "sigs_saved",
+        "certs_rejected",
+        "cert_timeouts",
+    ):
+        assert key in snap
+    assert 0.0 <= snap["cert_fastpath_fraction"] <= 1.0
+    assert snap["cert_fastpath_fraction"] > 0
+
+
+def test_cert_mode_requires_named_verifier():
+    # an explicit ctor request on a keyless sim is a hard error ...
+    with pytest.raises(ValueError):
+        Simulation(Config(n=4), cert=True)
+
+
+def test_cert_knob_on_keyless_sim_degrades_to_off():
+    # ... but the knob (Config(cert="agg") / DAGRIDER_CERT=agg, as the
+    # tier1-agg CI lane sets) must not break sims with no signature
+    # machinery: they fall back to the reference per-vertex path.
+    sim = Simulation(Config(n=4, cert="agg"))
+    assert sim.cfg.cert == "off"
+    assert sim.cert_verifier is None
+    assert all(not p._cert for p in sim.processes)
+
+
+# ---------------------------------------------------------------------------
+# Byzantine aggregator at the process seam
+# ---------------------------------------------------------------------------
+
+
+def _cert_msg(cert, sender=0):
+    return BroadcastMessage(
+        vertex=None, round=cert.round, sender=sender, kind="cert", cert=cert
+    )
+
+
+def test_forged_certificate_rejected_and_round_degraded():
+    """A forged aggregate from the wire is rejected by the aggregate
+    check and the covered round falls back onto the per-vertex path:
+    pooled vertices re-queue for individual verification, the books say
+    rejected + degraded, and the process keeps delivering."""
+    _, sim = _run_cert(4, 4, cert=True)
+    p = sim.processes[1]
+    r = p.round + 2
+    if r % 4 == p.index:  # pick a round this process does NOT aggregate
+        r += 1
+    pooled = _vertex(rnd=r, src=(p.index + 1) % 4)
+    p._cert_pool[r] = {pooled.id.source: pooled}
+    forged = RoundCertificate(
+        round=r,
+        signers=(0, 1, 2),
+        digests=tuple(_digests(b"forged", 3)),
+        agg_sig=bls.g1_compress(bls.g1_mul(0xBAD)),
+    )
+    before = p.metrics.counters.get("certs_rejected", 0)
+    # apply without stepping so the re-queued vertex is observable
+    # before the verify queue drains it
+    assert p._apply_certificate(forged) is False
+    assert p.metrics.counters["certs_rejected"] == before + 1
+    assert p.metrics.counters["cert_rounds_degraded"] >= 1
+    assert r not in p._cert_pool and r in p._cert_done
+    assert any(v.id == pooled.id for v in p._pending_verify)
+    # replays of the same junk are now ignored, not re-checked
+    checked = sim.cert_verifier.stats["certs_checked"]
+    p._on_certificate(_cert_msg(forged))
+    assert p.metrics.counters["certs_ignored"] >= 1
+    assert sim.cert_verifier.stats["certs_checked"] == checked
+
+
+def test_silent_aggregator_times_out_and_degrades():
+    """Liveness rung: an aggregator that never gossips costs its round
+    cert_patience quiescent steps, then the pooled vertices flow through
+    the normal verify queue — a Byzantine aggregator cannot block."""
+    _, sim = _run_cert(4, 5, cert=True)
+    p = sim.processes[2]
+    r = p.round + 2
+    if r % 4 == p.index:
+        r += 1
+    pooled = _vertex(rnd=r, src=(p.index + 1) % 4)
+    p._cert_pool[r] = {pooled.id.source: pooled}
+    for _ in range(p.cfg.cert_patience + 1):
+        p.step()
+    assert p.metrics.counters["cert_timeouts"] == 1
+    assert p.metrics.counters["cert_rounds_degraded"] >= 1
+    assert r not in p._cert_pool and r in p._cert_done
+
+
+def test_stale_certificate_for_pruned_round_ignored():
+    _, sim = _run_cert(4, 6, cert=True)
+    p = sim.processes[1]
+    # at or below the GC floor (genesis when nothing pruned yet): the
+    # certificate is dropped unexamined — no pairing, no reject
+    stale = RoundCertificate(
+        round=p.dag.base_round,
+        signers=(0, 1, 2),
+        digests=tuple(_digests(b"old", 3)),
+        agg_sig=bls.g1_compress(bls.g1_mul(3)),
+    )
+    before = p.metrics.counters.get("certs_rejected", 0)
+    p._on_certificate(_cert_msg(stale))
+    assert p.metrics.counters.get("certs_rejected", 0) == before
+    assert p.metrics.counters["certs_ignored"] >= 1

@@ -191,6 +191,75 @@ def test_subscribe_many_requires_existing_subscription():
 
 
 # ---------------------------------------------------------------------------
+# FaultyTransport grouped-pump path (round 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _run_faulty(transport, pump: str, *, flushes: int = 0):
+    cfg = Config(n=4, coin="round_robin", pump=pump, propose_empty=False)
+    kwargs = {"transport": transport} if transport is not None else {}
+    sim = Simulation(cfg, **kwargs)
+    sim.submit_blocks(per_process=8)
+    sim.run(max_messages=40_000)
+    for _ in range(flushes):
+        transport.flush_delayed()
+        sim.run(max_messages=40_000)
+    sim.check_agreement()
+    return _delivery_logs(sim, range(cfg.n))
+
+
+def test_faulty_grouped_zero_plan_byte_identical():
+    """A delay-free FaultyTransport grows the grouped-pump seam and the
+    fan-out sentinel forward; under an all-zero plan the vector run is
+    byte-identical to one over a bare InMemoryTransport."""
+    tp = FaultyTransport(FaultPlan(seed=3))
+    assert callable(getattr(tp, "pump_grouped", None))
+    wrapped = _run_faulty(tp, "vector")
+    plain = _run_faulty(None, "vector")
+    assert any(wrapped)
+    assert wrapped == plain
+    # the sentinel write-through reached the inner transport
+    assert tp.fanout_sentinel is True
+    assert tp.inner.fanout_sentinel is True
+
+
+def test_faulty_grouped_duplicate_plan_live():
+    """Delay-free fault plans ride the grouped path: rolls land per
+    message inside the batch wrapper, stats count them, and dedup keeps
+    agreement byte-identical across processes."""
+    tp = FaultyTransport(FaultPlan(duplicate=0.3, seed=5))
+    assert callable(getattr(tp, "pump_grouped", None))
+    logs = _run_faulty(tp, "vector")
+    assert any(logs)
+    assert tp.stats["duplicated"] > 0
+
+
+def test_faulty_delay_plan_falls_back_byte_identical():
+    """Fallback contract: a plan that can HOLD a message never grows
+    pump_grouped (the Simulation's callable-probe then picks per-message
+    pumping), and the vector run's delivery log equals the scalar run's
+    under the same plan and seed — same rolls, same schedule, same
+    bytes."""
+    tp_vec = FaultyTransport(FaultPlan(delay=0.2, seed=9))
+    assert getattr(tp_vec, "pump_grouped", None) is None
+    vec = _run_faulty(tp_vec, "vector", flushes=8)
+    assert tp_vec.stats["delayed"] > 0
+    tp_sca = FaultyTransport(FaultPlan(delay=0.2, seed=9))
+    sca = _run_faulty(tp_sca, "scalar", flushes=8)
+    assert any(vec)
+    assert vec == sca
+
+
+def test_faulty_wan_topology_falls_back():
+    from dag_rider_tpu.transport.faults import WanTopology
+
+    tp = FaultyTransport(
+        FaultPlan(seed=1), topology=WanTopology.regions(4)
+    )
+    assert getattr(tp, "pump_grouped", None) is None
+
+
+# ---------------------------------------------------------------------------
 # end-to-end equivalence fuzz
 # ---------------------------------------------------------------------------
 
@@ -287,7 +356,14 @@ def _run_adversary(
 
 
 @pytest.mark.parametrize(
-    "adversary", ["equivocate", "withhold", "invalid_edges"]
+    "adversary",
+    [
+        "equivocate",
+        "withhold",
+        "invalid_edges",
+        "garbage_coin",
+        "equivocate_split",
+    ],
 )
 @pytest.mark.parametrize("seed", [0, 1])
 def test_adversary_equivalence(adversary, seed):
