@@ -908,7 +908,9 @@ def _measure() -> None:
             return False
         compile_s = time.monotonic() - t0
         _mark(f"{tag}: compile+warm done in {compile_s:.1f}s; timing")
-        profile_dir = os.environ.get("DAGRIDER_PROFILE_DIR")
+        from dag_rider_tpu import config as _cfg
+
+        profile_dir = _cfg.env_str("DAGRIDER_PROFILE_DIR")
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         try:
@@ -1339,9 +1341,10 @@ def _measure() -> None:
                 "ok": True,
                 "skipped": False,
             }
+            from dag_rider_tpu import config as _cfg
+
             out_path = os.path.join(
-                _REPO,
-                os.environ.get("DAGRIDER_AGG_OUT", "BENCH_r06.json"),
+                _REPO, _cfg.env_str("DAGRIDER_AGG_OUT")
             )
             with open(out_path, "w") as fh:
                 json.dump(rec, fh, indent=1)
@@ -1768,11 +1771,10 @@ def _measure() -> None:
                     ok=True,
                     skipped=False,
                 )
+                from dag_rider_tpu import config as _cfg
+
                 out_path = os.path.join(
-                    _REPO,
-                    os.environ.get(
-                        "DAGRIDER_MULTICHIP_OUT", "MULTICHIP_r06.json"
-                    ),
+                    _REPO, _cfg.env_str("DAGRIDER_MULTICHIP_OUT")
                 )
                 with open(out_path, "w") as fh:
                     json.dump(rec, fh, indent=1)

@@ -1,0 +1,34 @@
+"""driderlint — project-invariant static analysis + race detection (round 14).
+
+The byte-identity A/B tests and the chaos suite check *behavior*; this
+package checks the *invariants those tests silently assume*, the way
+"Reusable Formal Verification of DAG-based Consensus Protocols"
+(arXiv 2407.02167) argues DAG-BFT correctness should be carried by
+reusable machine-checked properties rather than per-change testing. The
+reference Go prototype got ``go vet`` and ``-race`` for free; this is
+the Python/JAX port's equivalent, specialized to THIS repo's seams:
+
+- ``knobs``       — every DAGRIDER_* env read routes through the
+                    config.py registry and appears in the README table
+- ``determinism`` — no wall clock, unseeded RNG, or set-iteration-order
+                    dependence on consensus commit paths
+- ``oracle``      — vector-pump / agg-cert-only code never mutates the
+                    scalar reference path's state (what every A/B
+                    byte-identity test assumes)
+- ``jitpure``     — no Python side effects inside jitted fns in ops/
+                    and parallel/
+- ``metrics``     — every counter bumped is registered in
+                    utils/metrics.KNOWN_COUNTERS
+- ``races``       — a runtime harness: lock-order cycle detection +
+                    guarded-field / serialized-method enforcement,
+                    driven by the existing chaos/fuzz suites under
+                    DAGRIDER_RACE=1
+
+Run the static suite with ``python -m dag_rider_tpu.analysis``; every
+checker is proven non-vacuous by a planted violation in
+tests/test_analysis.py, mirroring the consensus/invariants.py pattern.
+"""
+
+from dag_rider_tpu.analysis.core import Allow, Finding, discover, run_static
+
+__all__ = ["Allow", "Finding", "discover", "run_static"]

@@ -1,0 +1,153 @@
+"""jit purity: traced functions must be side-effect-free Python.
+
+A jitted function's Python body runs at TRACE time, once per cache key
+— not per call. Any Python side effect inside it (env read, print,
+file I/O, global mutation, wall clock, RNG) therefore fires on a
+schedule the caller cannot reason about: once, never again, or again
+on every retrace. The rule over ``ops/`` and ``parallel/``: nothing in
+a jitted function may touch the world outside its arguments.
+
+Detected jit spellings: ``@jax.jit`` / ``@jit`` decorators,
+``@functools.partial(jax.jit, ...)`` / ``@partial(jit, ...)``, and
+module-level ``name = jax.jit(fn)`` rebinding a function defined in
+the same file. Host callbacks (``pure_callback`` / ``io_callback`` /
+``jax.debug.callback``) are flagged wherever they appear in scope —
+the repo's design keeps ALL host work outside the traced region.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from dag_rider_tpu.analysis.core import Finding, SourceFile
+
+CHECKER = "jitpure"
+
+_SCOPES = ("dag_rider_tpu/ops/", "dag_rider_tpu/parallel/")
+
+_BANNED_CALLS = {
+    "print": "print() at trace time",
+    "open": "file I/O at trace time",
+    "input": "console input at trace time",
+    "time.time": "wall clock at trace time",
+    "time.monotonic": "clock read at trace time",
+    "time.perf_counter": "clock read at trace time",
+    "time.sleep": "sleep at trace time",
+    "os.getenv": "environment read at trace time",
+    "os.environ.get": "environment read at trace time",
+    "jax.pure_callback": "host callback inside a jitted fn",
+    "jax.experimental.io_callback": "host callback inside a jitted fn",
+    "jax.debug.callback": "host callback inside a jitted fn",
+    "pure_callback": "host callback inside a jitted fn",
+    "io_callback": "host callback inside a jitted fn",
+}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` and ``partial(jax.jit, ...)``."""
+    d = _dotted(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        f = _dotted(node.func)
+        if f in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        # e.g. jax.jit(..., static_argnames=...) used as a decorator
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _jitted_functions(tree: ast.Module) -> Set[str]:
+    """Names of module-level functions that are jitted, via decorator or
+    a later ``x = jax.jit(name)`` rebinding."""
+    defs = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    jitted: Set[str] = set()
+    for name, fn in defs.items():
+        if any(_is_jit_expr(d) for d in fn.decorator_list):
+            jitted.add(name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_expr(node.value.func) and node.value.args:
+                arg = node.value.args[0]
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    jitted.add(arg.id)
+    return jitted
+
+
+def _check_body(rel: str, fn: ast.AST) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.append(
+                Finding(
+                    CHECKER,
+                    rel,
+                    node.lineno,
+                    f"global statement inside jitted {fn.name}()",
+                )
+            )
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            why = _BANNED_CALLS.get(d)
+            if why is None and (
+                d.startswith("random.")
+                or d.startswith("np.random.")
+                or d.startswith("numpy.random.")
+            ):
+                why = "host RNG at trace time"
+            if why is None and d in ("os.environ.get",):
+                why = "environment read at trace time"
+            if why is not None:
+                out.append(
+                    Finding(
+                        CHECKER,
+                        rel,
+                        node.lineno,
+                        f"{d}() inside jitted {fn.name}() — {why}",
+                    )
+                )
+        if isinstance(node, ast.Subscript):
+            if _dotted(node.value) == "os.environ":
+                out.append(
+                    Finding(
+                        CHECKER,
+                        rel,
+                        node.lineno,
+                        f"os.environ[...] inside jitted {fn.name}() — "
+                        "environment read at trace time",
+                    )
+                )
+    return out
+
+
+def run(files: Sequence[SourceFile], repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, tree, _src in files:
+        if not rel.startswith(_SCOPES):
+            continue
+        jitted = _jitted_functions(tree)
+        for fn in ast.walk(tree):
+            if (
+                isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name in jitted
+            ):
+                findings.extend(_check_body(rel, fn))
+    return findings

@@ -11,7 +11,190 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Central DAGRIDER_* knob registry (round 14).
+#
+# Every environment variable the package reads must be registered here and
+# read through one of the env_* accessors below; the driderlint knob checker
+# (dag_rider_tpu/analysis/knobs.py) rejects any direct ``os.environ`` read of
+# a DAGRIDER_* name outside this module, and cross-checks that every
+# registered knob appears in the README knob table. bench.py's
+# DAGRIDER_BENCH_* namespace is the one carve-out (bench-local tuning, never
+# read by the package).
+# ---------------------------------------------------------------------------
+
+#: shared env-flag convention: anything but these (case-insensitive) is on
+_OFF_WORDS = ("0", "false", "no", "off")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered environment knob.
+
+    ``kind`` is "flag" | "int" | "float" | "str" | "choice"; ``default``
+    is the value an empty/unset variable resolves to (already typed);
+    ``choices``/``minimum`` carry the validation the accessor enforces.
+    """
+
+    name: str
+    kind: str
+    default: object
+    doc: str
+    choices: Optional[Tuple[str, ...]] = None
+    minimum: Optional[float] = None
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _register(
+    name: str,
+    kind: str,
+    default: object,
+    doc: str,
+    choices: Optional[Tuple[str, ...]] = None,
+    minimum: Optional[float] = None,
+) -> None:
+    KNOBS[name] = Knob(name, kind, default, doc, choices, minimum)
+
+
+_register("DAGRIDER_PUMP", "choice", "scalar",
+          "host consensus pump path", choices=("scalar", "vector"))
+_register("DAGRIDER_CERT", "choice", "off",
+          "aggregated round certificates", choices=("off", "agg"))
+_register("DAGRIDER_CERT_MSM", "choice", "host",
+          "certificate-aggregation MSM backend",
+          choices=("host", "device", "sharded"))
+_register("DAGRIDER_MESH", "int", None,
+          "batch-axis device count for the sharded verifier mesh",
+          minimum=1)
+_register("DAGRIDER_SHARDED_COMB_IMPL", "str", "",
+          "per-shard comb impl override (e.g. pallas_interpret)")
+_register("DAGRIDER_VERIFY_DEPTH", "int", 2,
+          "pipeline in-flight window depth", minimum=1)
+_register("DAGRIDER_VERIFY_RETRY", "int", 1,
+          "bounded retry count per resilient-verifier tier", minimum=0)
+_register("DAGRIDER_VERIFY_FALLBACK", "str", "",
+          "fallback-tier selector (cpu, or 0/off/none/false for none)")
+_register("DAGRIDER_PREP_WORKERS", "int", 1,
+          "parallel host-prep worker count", minimum=1)
+_register("DAGRIDER_NATIVE", "flag", True,
+          "native challenge hashing (hashlib fallback when off)")
+_register("DAGRIDER_COMB", "flag", True,
+          "fixed-key comb tables for the TPU verifier")
+_register("DAGRIDER_COMB_BITS", "choice", "",
+          "comb table window width", choices=("", "4", "8"))
+_register("DAGRIDER_PALLAS_GROUP", "flag", True,
+          "Pallas group-op kernels on real TPU backends")
+_register("DAGRIDER_MSM_PALLAS", "flag", True,
+          "Mosaic MSM kernels on real TPU backends")
+_register("DAGRIDER_MEMPOOL_CAP", "int", 65536,
+          "mempool capacity in transactions", minimum=1)
+_register("DAGRIDER_BATCH_BYTES", "int", 8192,
+          "target payload bytes per built block", minimum=1)
+_register("DAGRIDER_BATCH_DEADLINE_MS", "float", 50.0,
+          "max hold latency before a partial batch ships", minimum=0)
+_register("DAGRIDER_ADMIT_WATERMARKS", "str", "",
+          'admission watermarks as "low,high" pool-fill fractions')
+_register("DAGRIDER_MEMPOOL_TTL_S", "float", 60.0,
+          "pending-transaction eviction age in seconds")
+_register("DAGRIDER_PROFILE_DIR", "str", "",
+          "jax.profiler trace output directory for bench runs")
+_register("DAGRIDER_AGG_OUT", "str", "BENCH_r06.json",
+          "aggregate-cert bench output path")
+_register("DAGRIDER_MULTICHIP_OUT", "str", "MULTICHIP_r06.json",
+          "multichip bench output path")
+_register("DAGRIDER_RACE", "flag", False,
+          "install the dynamic lock-race harness under pytest")
+
+
+def _raw(name: str) -> str:
+    if name not in KNOBS:
+        raise KeyError(
+            f"unregistered DAGRIDER knob {name!r} — add it to "
+            "dag_rider_tpu.config.KNOBS"
+        )
+    return os.environ.get(name, "").strip()
+
+
+def env_flag(name: str, default: Optional[bool] = None) -> bool:
+    """Registered boolean knob; empty/unset resolves to the registry
+    default. Anything but 0/false/no/off (case-insensitive) is on."""
+    raw = _raw(name)
+    if not raw:
+        d = KNOBS[name].default if default is None else default
+        return bool(d)
+    return raw.lower() not in _OFF_WORDS
+
+
+def env_str(name: str, default: Optional[str] = None) -> str:
+    raw = _raw(name)
+    if raw:
+        return raw
+    return str(KNOBS[name].default if default is None else default)
+
+
+def env_choice(name: str, default: Optional[str] = None) -> str:
+    """Registered enumerated knob; raises ValueError outside choices."""
+    knob = KNOBS[name]
+    raw = _raw(name)
+    val = raw if raw else str(knob.default if default is None else default)
+    if knob.choices is not None and val not in knob.choices:
+        raise ValueError(
+            f"{name} must be one of {knob.choices}, got {val!r}"
+        )
+    return val
+
+
+def env_int(name: str, default: Optional[int] = None) -> int:
+    knob = KNOBS[name]
+    raw = _raw(name)
+    if not raw:
+        return int(knob.default if default is None else default)  # type: ignore[arg-type]
+    try:
+        val = int(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} must be an int, got {raw!r}") from e
+    if knob.minimum is not None and val < knob.minimum:
+        raise ValueError(
+            f"{name} must be >= {int(knob.minimum)}, got {raw!r}"
+        )
+    return val
+
+
+def env_opt_int(name: str) -> Optional[int]:
+    """Registered optional int knob: unset/empty yields None."""
+    knob = KNOBS[name]
+    raw = _raw(name)
+    if not raw:
+        return None
+    try:
+        val = int(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} must be an int, got {raw!r}") from e
+    if knob.minimum is not None and val < knob.minimum:
+        raise ValueError(
+            f"{name} must be >= {int(knob.minimum)}, got {raw!r}"
+        )
+    return val
+
+
+def env_float(name: str, default: Optional[float] = None) -> float:
+    knob = KNOBS[name]
+    raw = _raw(name)
+    if not raw:
+        return float(knob.default if default is None else default)  # type: ignore[arg-type]
+    try:
+        val = float(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} must be a float, got {raw!r}") from e
+    if knob.minimum is not None and val < knob.minimum:
+        raise ValueError(
+            f"{name} must be >= {knob.minimum}, got {raw!r}"
+        )
+    return val
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,21 +291,13 @@ class Config:
         if self.n < 1:
             raise ValueError(f"n must be >= 1, got {self.n}")
         if self.pump is None:
-            object.__setattr__(
-                self,
-                "pump",
-                os.environ.get("DAGRIDER_PUMP", "").strip() or "scalar",
-            )
+            object.__setattr__(self, "pump", env_choice("DAGRIDER_PUMP"))
         if self.pump not in ("scalar", "vector"):
             raise ValueError(
                 f'pump must be "scalar" or "vector", got {self.pump!r}'
             )
         if self.cert is None:
-            object.__setattr__(
-                self,
-                "cert",
-                os.environ.get("DAGRIDER_CERT", "").strip() or "off",
-            )
+            object.__setattr__(self, "cert", env_choice("DAGRIDER_CERT"))
         if self.cert not in ("off", "agg"):
             raise ValueError(
                 f'cert must be "off" or "agg", got {self.cert!r}'
@@ -178,16 +353,6 @@ class Config:
         if rnd < 1:
             raise ValueError("rounds >= 1 belong to waves; round 0 is genesis")
         return (rnd - 1) // self.wave_length + 1
-
-
-def _env_num(name: str, default: float, cast) -> float:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        return cast(raw)
-    except ValueError as e:
-        raise ValueError(f"{name} must be a {cast.__name__}, got {raw!r}") from e
 
 
 @dataclasses.dataclass(frozen=True)
@@ -287,21 +452,19 @@ class MempoolConfig:
     def from_env(cls) -> "MempoolConfig":
         low, high = cls._env_watermarks()
         return cls(
-            cap=int(_env_num("DAGRIDER_MEMPOOL_CAP", cls.cap, int)),
-            batch_bytes=int(
-                _env_num("DAGRIDER_BATCH_BYTES", cls.batch_bytes, int)
-            ),
-            batch_deadline_ms=_env_num(
-                "DAGRIDER_BATCH_DEADLINE_MS", cls.batch_deadline_ms, float
+            cap=env_int("DAGRIDER_MEMPOOL_CAP", cls.cap),
+            batch_bytes=env_int("DAGRIDER_BATCH_BYTES", cls.batch_bytes),
+            batch_deadline_ms=env_float(
+                "DAGRIDER_BATCH_DEADLINE_MS", cls.batch_deadline_ms
             ),
             admit_low=low,
             admit_high=high,
-            ttl_s=_env_num("DAGRIDER_MEMPOOL_TTL_S", cls.ttl_s, float),
+            ttl_s=env_float("DAGRIDER_MEMPOOL_TTL_S", cls.ttl_s),
         )
 
     @staticmethod
     def _env_watermarks() -> Tuple[float, float]:
-        raw = os.environ.get("DAGRIDER_ADMIT_WATERMARKS", "").strip()
+        raw = env_str("DAGRIDER_ADMIT_WATERMARKS")
         if not raw:
             return MempoolConfig.admit_low, MempoolConfig.admit_high
         parts = raw.split(",")

@@ -35,7 +35,7 @@ import argparse
 import dataclasses
 import json
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus import invariants as inv

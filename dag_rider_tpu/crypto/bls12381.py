@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
-from typing import List, Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 # --- base field / curve parameters (standard BLS12-381 constants) ----------
 

@@ -36,7 +36,7 @@ host affine tuples (already decompressed/validated by
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -248,14 +248,9 @@ def msm_impl(t: int) -> str:
     kernels on a real TPU backend for lane-aligned batches, portable jnp
     everywhere else. DAGRIDER_MSM_PALLAS=0 (default 1) pins jnp — the
     kernels are bit-identical, this is purely a speed selection."""
-    import os
+    from dag_rider_tpu import config
 
-    if os.environ.get("DAGRIDER_MSM_PALLAS", "1").lower() in (
-        "0",
-        "false",
-        "no",
-        "off",
-    ):
+    if not config.env_flag("DAGRIDER_MSM_PALLAS"):
         return "jnp"
     if t >= 128 and jax.default_backend() in ("tpu", "axon"):
         return "pallas"

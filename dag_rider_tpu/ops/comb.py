@@ -34,7 +34,6 @@ the reference itself has no crypto (process.go carries none — D10).
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import numpy as np
 import jax

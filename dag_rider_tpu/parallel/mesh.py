@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import os
 import warnings
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from dag_rider_tpu import config
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -79,10 +81,7 @@ def mesh_from_env(default_devices: int = 8) -> Mesh:
     8-device fallback the tests run on. If jax already initialized with
     fewer devices than requested, the mesh clamps with a warning rather
     than failing the node."""
-    raw = os.environ.get("DAGRIDER_MESH", "").strip()
-    want = int(raw) if raw else None
-    if want is not None and want < 1:
-        raise ValueError(f"DAGRIDER_MESH must be >= 1, got {raw!r}")
+    want = config.env_opt_int("DAGRIDER_MESH")
     platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
     flags = os.environ.get("XLA_FLAGS", "")
     if (

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dag_rider_tpu.ops import bls_msm, field381 as F
+from dag_rider_tpu.ops import bls_msm
 from dag_rider_tpu.parallel.mesh import make_mesh, shard_map
 
 

@@ -11,7 +11,6 @@ replicated) in a single jitted program. The host state machine consumes
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -45,16 +45,15 @@ attribute shadows (tests/test_chaos.py runs its suite on both).
 from __future__ import annotations
 
 import functools
-import os
 import warnings
-from typing import List, Optional, Sequence
+from typing import Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu import config
 from dag_rider_tpu.ops import curve, field
 from dag_rider_tpu.parallel.mesh import (
     batch_sharding,
@@ -181,7 +180,7 @@ class ShardedTPUVerifier(TPUVerifier):
         # DAGRIDER_SHARDED_COMB_IMPL overrides — e.g. "pallas_interpret"
         # exercises the kernel bodies on the virtual CPU mesh
         # (dryrun_multichip / tests).
-        return os.environ.get("DAGRIDER_SHARDED_COMB_IMPL") or _comb_impl(
+        return config.env_str("DAGRIDER_SHARDED_COMB_IMPL") or _comb_impl(
             max(1, size // self._n_shards)
         )
 

@@ -52,11 +52,17 @@ class _DeliverHandler(grpc.GenericRpcHandler):
         snapshot_min_interval_s: float = 1.0,
         snapshot_freshness_s: Optional[float] = 300.0,
         metrics_inc: Optional[Callable[[str], None]] = None,
+        wall_clock: Callable[[], float] = time.time,
     ):
         self._sink = sink
         self._snapshot = snapshot_provider
         self._auth = auth
         self._inc = metrics_inc if metrics_inc is not None else lambda _n: None
+        # Injectable wall clock (tests/virtual time): freshness is a
+        # cross-host comparison, so it NEEDS wall time in production —
+        # but the default must be overridable or the freshness window is
+        # untestable without real sleeps.
+        self._wall = wall_clock
         # <= 0 normalizes to the unthrottled / uncheck-everything intent
         # (and keeps the token-bucket divisor positive): interval 0 means
         # "no per-relayer throttle", freshness 0 means "no freshness
@@ -167,7 +173,7 @@ class _DeliverHandler(grpc.GenericRpcHandler):
                         return b""
                     if (
                         self._snap_freshness is not None
-                        and abs(time.time() - ts) > self._snap_freshness
+                        and abs(self._wall() - ts) > self._snap_freshness
                     ):
                         self._inc("net_snapshot_stale_refusals")
                         return b""
@@ -291,8 +297,12 @@ class GrpcTransport(Transport):
         snapshot_provider: Optional[Callable[[], bytes]] = None,
         snapshot_min_interval_s: float = 1.0,
         snapshot_freshness_s: Optional[float] = 300.0,
+        wall_clock: Callable[[], float] = time.time,
     ):
         self.index = index
+        #: injectable wall clock for snapshot-request timestamps (the
+        #: donor-side freshness gate compares against the same clock)
+        self._wall = wall_clock
         self._peers = dict(peers)
         #: Optional FrameAuth (transport/auth.py): every outgoing frame
         #: carries a per-peer MAC and every incoming frame must carry a
@@ -343,6 +353,7 @@ class GrpcTransport(Transport):
                     # entirely) rather than wedge recovering nodes
                     snapshot_freshness_s=snapshot_freshness_s,
                     metrics_inc=self._inc,
+                    wall_clock=wall_clock,
                 ),
             )
         )
@@ -561,7 +572,7 @@ class GrpcTransport(Transport):
             # clock step (first NTP sync mid-recovery) cannot make our
             # own requests read as stale/replayed at the donor.
             with self._lock:
-                t = max(time.time(), self._snap_req_ts + 1e-3)
+                t = max(self._wall(), self._snap_req_ts + 1e-3)
                 self._snap_req_ts = t
             ts = struct.pack("<d", t)
             req = (

@@ -22,6 +22,66 @@ from typing import Deque, Dict
 #: keep every sample, small enough to bound week-long nodes
 SAMPLE_WINDOW = 65536
 
+#: Every counter name any module may bump (round 14). The driderlint
+#: metrics checker (analysis/metricsreg.py) rejects a literal
+#: ``metrics.inc("...")`` / ``self._inc("...")`` / ``counters["..."]``
+#: whose name is not registered here — a typo'd counter silently
+#: creating a new defaultdict key is the observability analogue of the
+#: typo'd-knob bug MempoolConfig.from_dict exists to kill.
+KNOWN_COUNTERS = frozenset(
+    {
+        # consensus/process.py — admission, rounds, waves, sync
+        "msgs_received",
+        "msgs_rejected_stamp",
+        "msgs_below_gc_horizon",
+        "equivocations_detected",
+        "msgs_duplicate",
+        "msgs_rejected_edges",
+        "msgs_ignored_kind",
+        "msgs_rejected_signature",
+        "vertices_admitted",
+        "vertices_proposed",
+        "vertices_delivered",
+        "vertices_pruned",
+        "rounds_advanced",
+        "waves_decided",
+        "waves_skipped",
+        "sync_requested",
+        "sync_attested_floor_raises",
+        "sync_nacks",
+        "sync_throttled",
+        "sync_refused_pruned",
+        "sync_served",
+        "state_transfers",
+        "pump_errors",
+        # aggregated round certificates (ISSUE 9)
+        "certs_ignored",
+        "certs_rejected",
+        "certs_verified",
+        "certs_assembled",
+        "sigs_saved",
+        "cert_rounds_degraded",
+        "cert_timeouts",
+        "cert_path_enabled",
+        # transport/net.py — wire health
+        "net_sends",
+        "net_sends_ok",
+        "net_send_errors",
+        "net_drops",
+        "net_retries",
+        "net_auth_rejects",
+        "net_peer_down",
+        "net_peer_recovered",
+        "net_snapshot_rejects",
+        "net_snapshot_stale_refusals",
+        "net_snapshot_replays",
+        "net_snapshot_throttled",
+        "net_snapshot_global_throttled",
+        "net_snapshot_fetches",
+        "net_snapshot_errors",
+    }
+)
+
 
 class Histogram:
     """Percentiles over a bounded reservoir (round-10 satellite).

@@ -29,9 +29,9 @@ simulator's dedup'd shared per-vertex dispatch.
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional, Sequence, Tuple
 
+from dag_rider_tpu import config
 from dag_rider_tpu.core.types import RoundCertificate
 from dag_rider_tpu.crypto import bls12381 as bls
 from dag_rider_tpu.verifier.base import KeyRegistry
@@ -42,11 +42,7 @@ _VERDICT_CACHE_MAX = 4096
 
 
 def _resolve_msm(msm: Optional[str]) -> str:
-    choice = (
-        msm
-        if msm is not None
-        else os.environ.get("DAGRIDER_CERT_MSM", "").strip() or "host"
-    )
+    choice = msm if msm is not None else config.env_choice("DAGRIDER_CERT_MSM")
     if choice not in ("host", "device", "sharded"):
         raise ValueError(
             f'cert MSM must be "host", "device" or "sharded", got {choice!r}'

@@ -32,11 +32,11 @@ pipeline only changes WHEN the host blocks, never WHAT it computes.
 
 from __future__ import annotations
 
-import os
 import time
 from collections import deque
 from typing import Callable, Deque, List, Optional, Sequence
 
+from dag_rider_tpu import config
 from dag_rider_tpu.core.types import Vertex
 from dag_rider_tpu.verifier.base import Verifier
 
@@ -48,11 +48,7 @@ def default_depth() -> int:
     2 is enough to overlap host prep with device execution (the two
     alternate); deeper windows only help when chunk execution time
     varies."""
-    raw = os.environ.get("DAGRIDER_VERIFY_DEPTH", "").strip()
-    depth = int(raw) if raw else 2
-    if depth < 1:
-        raise ValueError(f"DAGRIDER_VERIFY_DEPTH must be >= 1, got {raw!r}")
-    return depth
+    return config.env_int("DAGRIDER_VERIFY_DEPTH")
 
 
 class VerifierPipeline(Verifier):

@@ -43,9 +43,11 @@ Gauges (`workers`, `last_blocks`, `parallel_fraction`) surface through
 
 from __future__ import annotations
 
-import os
+import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, List, Optional, Tuple
+
+from dag_rider_tpu import config
 
 #: Smallest row block worth a thread handoff: below this the numpy
 #: slices are so small that submit/wake costs exceed the work moved.
@@ -59,13 +61,7 @@ def default_prep_workers() -> int:
     DAGRIDER_PREP_WORKERS, default 1 (serial — byte-identical by
     construction, and the right call on one-core hosts). N > 1 splits
     every big-enough prep into up to N row blocks."""
-    raw = os.environ.get("DAGRIDER_PREP_WORKERS", "").strip()
-    workers = int(raw) if raw else 1
-    if workers < 1:
-        raise ValueError(
-            f"DAGRIDER_PREP_WORKERS must be >= 1, got {raw!r}"
-        )
-    return workers
+    return config.env_int("DAGRIDER_PREP_WORKERS")
 
 
 class PrepEngine:
@@ -96,7 +92,13 @@ class PrepEngine:
         #: lazy single-thread FIFO executor for whole-prep-call
         #: overlap on the pipeline seam (see submit())
         self._seam: Optional[ThreadPoolExecutor] = None
-        #: gauges — cumulative over the engine's lifetime
+        #: gauges — cumulative over the engine's lifetime. Guarded by
+        #: _gauge_lock: run_blocks legitimately overlaps itself (the
+        #: caller thread preps chunk k+1 while the seam thread preps
+        #: k+2 into a DIFFERENT ring slot), so the read-modify-write
+        #: bumps below race without it — the round-14 race harness
+        #: caught exactly this under tests/test_chaos.py.
+        self._gauge_lock = threading.Lock()
         self.last_blocks = 1
         self.dispatches = 0
         self.dispatches_parallel = 0
@@ -138,15 +140,17 @@ class PrepEngine:
         partially written. Only if the serial pass also fails does the
         exception surface — the staging slot is then considered
         unwritten and the dispatch must not ship."""
-        self.dispatches += 1
         size = blocks[-1][1]
-        self.rows_total += size
-        self.last_blocks = len(blocks)
+        with self._gauge_lock:
+            self.dispatches += 1
+            self.rows_total += size
+            self.last_blocks = len(blocks)
         if len(blocks) == 1:
             fn(*blocks[0])
             return
-        self.dispatches_parallel += 1
-        self.rows_parallel += size
+        with self._gauge_lock:
+            self.dispatches_parallel += 1
+            self.rows_parallel += size
         futs = [self._pool.submit(fn, lo, hi) for lo, hi in blocks[1:]]
         failed = False
         try:
@@ -159,7 +163,8 @@ class PrepEngine:
             except Exception:  # noqa: BLE001 — retried serially below
                 failed = True
         if failed:
-            self.serial_retries += 1
+            with self._gauge_lock:
+                self.serial_retries += 1
             fn(0, size)
 
     # -- pipeline-seam half ----------------------------------------------

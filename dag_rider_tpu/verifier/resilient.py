@@ -40,12 +40,12 @@ verifiers onto a CPUVerifier floor; default off) — node.py config keys
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
 from typing import List, Optional, Sequence
 
+from dag_rider_tpu import config
 from dag_rider_tpu.core.types import Vertex
 from dag_rider_tpu.verifier.base import Verifier
 
@@ -53,18 +53,14 @@ from dag_rider_tpu.verifier.base import Verifier
 def default_verify_retry() -> int:
     """Bounded retry count per ladder tier: DAGRIDER_VERIFY_RETRY,
     default 1 (one re-attempt before falling a tier)."""
-    raw = os.environ.get("DAGRIDER_VERIFY_RETRY", "").strip()
-    retry = int(raw) if raw else 1
-    if retry < 0:
-        raise ValueError(f"DAGRIDER_VERIFY_RETRY must be >= 0, got {raw!r}")
-    return retry
+    return config.env_int("DAGRIDER_VERIFY_RETRY")
 
 
 def default_verify_fallback() -> str:
     """Fallback-tier selector for node.py: DAGRIDER_VERIFY_FALLBACK,
     default "" (no ladder — the pre-round-9 single-backend shape).
     "cpu" appends a CPUVerifier floor under the configured verifier."""
-    val = os.environ.get("DAGRIDER_VERIFY_FALLBACK", "").strip().lower()
+    val = config.env_str("DAGRIDER_VERIFY_FALLBACK").lower()
     if val in ("", "0", "off", "none", "false"):
         return ""
     if val != "cpu":

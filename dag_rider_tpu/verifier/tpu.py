@@ -32,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dag_rider_tpu import config
 from dag_rider_tpu.core.types import Vertex
 from dag_rider_tpu.crypto import ed25519
 from dag_rider_tpu.ops import curve, field
@@ -41,22 +42,10 @@ from dag_rider_tpu.verifier.prep import PrepEngine
 _MIN_BUCKET = 16
 
 
-def _env_flag(name: str, default: str = "1") -> bool:
-    """Shared env-flag convention: anything but 0/false/no/off is on."""
-    import os
-
-    return os.environ.get(name, default).lower() not in (
-        "0",
-        "false",
-        "no",
-        "off",
-    )
-
-
 def _native_enabled() -> bool:
     """Native challenge hashing on by default; DAGRIDER_NATIVE=0 (or
     false/no/off) disables — the hashlib fallback is always available."""
-    return _env_flag("DAGRIDER_NATIVE")
+    return config.env_flag("DAGRIDER_NATIVE")
 
 
 def _bucket(n: int) -> int:
@@ -262,7 +251,7 @@ def _comb_impl(size: int) -> str:
     on HLO temps; the kernels do one HBM pass per operand). The axon
     PJRT relay has registered the chip as platform "tpu" or "axon"
     depending on plugin version — accept both."""
-    if not _env_flag("DAGRIDER_PALLAS_GROUP"):
+    if not config.env_flag("DAGRIDER_PALLAS_GROUP"):
         return "jnp"
     if size >= 128 and jax.default_backend() in ("tpu", "axon"):
         return "pallas"
@@ -297,10 +286,8 @@ class TPUVerifier(Verifier):
         masks. ``comb=False`` is the original windowed path — kept as the
         differential oracle and for registries too large for table HBM
         (~360 KB/key)."""
-        import os
-
         if comb is None:
-            comb = _env_flag("DAGRIDER_COMB")
+            comb = config.env_flag("DAGRIDER_COMB")
         self._comb = comb
         # Window width. 8-bit tables halve the gather rows and tree
         # levels but cost 16x the HBM (1.07 GB padded at n=256) and
@@ -308,11 +295,7 @@ class TPUVerifier(Verifier):
         # merged — the bigger table's gather locality eats the row-count
         # saving; PROFILE.md round 3), so 4-bit is the default and 8-bit
         # stays as a correct, tested variant (DAGRIDER_COMB_BITS=8).
-        bits_env = os.environ.get("DAGRIDER_COMB_BITS", "").strip()
-        if bits_env and bits_env not in ("4", "8"):
-            raise ValueError(
-                f"DAGRIDER_COMB_BITS must be 4 or 8, got {bits_env!r}"
-            )
+        bits_env = config.env_choice("DAGRIDER_COMB_BITS")
         self._comb_bits = int(bits_env) if bits_env else 4
         self._key_tables = None  # device tables, built lazily
         # AOT-compiled executables keyed (size, impl, bits) — see warmup()

@@ -35,6 +35,33 @@ from dag_rider_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
 
+# Dynamic lock-race harness (round 14, analysis/races.py): under
+# DAGRIDER_RACE=1 every package lock is order-tracked (deadlock cycles
+# raise at the acquire attempt) and the declared guarded-field /
+# serialized-method classes are enforced on every instance the suite
+# builds — the chaos/fuzz tests become the race driver with zero
+# per-test code. Installed at conftest import so it precedes any
+# instance construction; violations raised in pool threads (which a
+# Future would swallow) are re-checked session-wide below.
+from dag_rider_tpu.config import env_flag as _env_flag  # noqa: E402
+
+_RACE = _env_flag("DAGRIDER_RACE")
+if _RACE:
+    from dag_rider_tpu.analysis import races as _races  # noqa: E402
+
+    _races.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _RACE:
+        leftover = _races.drain_violations()
+        if leftover:
+            raise _races.RaceViolation(
+                "race harness recorded violation(s) the tests did not "
+                "surface (worker-thread raises swallowed by Futures):\n"
+                + "\n".join(leftover)
+            )
+
 
 # Long-tail tests (>= ~10 s each on this host, measured with
 # --durations=50; together ~75% of suite wall time). Kept here as the

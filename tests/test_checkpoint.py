@@ -5,7 +5,6 @@ able to crash after any step and resume with the exact same delivered
 prefix and continue to agreement with the rest of the cluster.
 """
 
-import dataclasses
 
 from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus.process import Process

@@ -1,7 +1,6 @@
 """DagState tests: the five reference path subtests run against the host
 mirrors (``process_internal_test.go:20-83``), plus insert/query invariants."""
 
-import numpy as np
 import pytest
 
 from dag_rider_tpu import Config

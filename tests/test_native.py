@@ -7,7 +7,6 @@ the verifier must produce identical masks with it on or off.
 """
 
 import hashlib
-import os
 
 import numpy as np
 import pytest

@@ -1,7 +1,6 @@
 """CLI node runner: keygen round-trip + a live 4-node localhost cluster."""
 
 import json
-import threading
 import time
 
 import pytest

@@ -6,7 +6,6 @@ same canonical value — before any on-chip timing matters.
 """
 
 import numpy as np
-import pytest
 
 from dag_rider_tpu.ops import field as F
 from dag_rider_tpu.ops import pallas_field

@@ -2,10 +2,9 @@
 (SURVEY.md §4: "no test of Start, waveReady, orderVertices,
 createNewVertex, or Transport itself")."""
 
-import pytest
 
 from dag_rider_tpu import Config
-from dag_rider_tpu.consensus import FixedCoin, Process, RoundRobinCoin, Simulation
+from dag_rider_tpu.consensus import FixedCoin, Process, Simulation
 from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
 from dag_rider_tpu.transport import InMemoryTransport
 

@@ -9,7 +9,6 @@ payload per (round, source) slot is ever delivered anywhere, and
 ``Simulation.check_agreement`` now compares delivered digests.
 """
 
-import dataclasses
 
 from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus.simulator import Simulation

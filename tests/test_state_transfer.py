@@ -18,7 +18,6 @@ import json
 import struct
 import time
 
-import pytest
 
 from dag_rider_tpu import Config
 from dag_rider_tpu.consensus import Process, Simulation

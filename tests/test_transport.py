@@ -4,7 +4,7 @@ race safety, decoupled delivery."""
 
 import threading
 
-from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
+from dag_rider_tpu.core.types import BroadcastMessage, Vertex, VertexID
 from dag_rider_tpu.transport import InMemoryTransport
 
 

@@ -6,7 +6,6 @@ import dataclasses
 from dag_rider_tpu import Config
 from dag_rider_tpu.consensus import Simulation
 from dag_rider_tpu.core.types import Block, BroadcastMessage, Vertex, VertexID
-from dag_rider_tpu.transport import InMemoryTransport
 from dag_rider_tpu.verifier import CPUVerifier, KeyRegistry, VertexSigner
 
 
