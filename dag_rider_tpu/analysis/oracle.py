@@ -52,6 +52,11 @@ CERT_ONLY_FUNCS = frozenset(
         "_degrade_cert_round",
         "_cert_tick",
         "_maybe_assemble_certs",
+        # cert-of-certs overlay (ISSUE 12)
+        "_on_span",
+        "_apply_span",
+        "_bank_span_cert",
+        "_maybe_assemble_spans",
     }
 )
 

@@ -108,6 +108,19 @@ _register("DAGRIDER_MULTICHIP_OUT", "str", "MULTICHIP_r06.json",
           "multichip bench output path")
 _register("DAGRIDER_RACE", "flag", False,
           "install the dynamic lock-race harness under pytest")
+_register("DAGRIDER_CERT_SIGN", "choice", "host",
+          "batched BLS share-signing backend",
+          choices=("host", "native", "device"))
+_register("DAGRIDER_CERT_PAIR", "choice", "host",
+          "certificate aggregate-pairing backend",
+          choices=("host", "device"))
+_register("DAGRIDER_CERT_SPAN", "int", 0,
+          "rounds per cert-of-certs span (0 disables span certificates)",
+          minimum=0)
+_register("DAGRIDER_CERT_SELFCHECK", "flag", True,
+          "aggregator self-verifies certificates before gossip")
+_register("DAGRIDER_CERT2_OUT", "str", "BENCH_r07.json",
+          "certificate-phase-2 bench output path")
 
 
 def _raw(name: str) -> str:
@@ -286,6 +299,20 @@ class Config:
     # cert latency of 1-2 steps and stay below sync_patience so a silent
     # aggregator degrades locally before the sync machinery fires.
     cert_patience: int = 6
+    # Cert-of-certs span width k (ISSUE 12 tentpole 3): every k
+    # consecutive verified round certificates fold into one
+    # SpanCertificate whose single combined pairing replaces k per-round
+    # checks on catch-up consumers. 0 disables spans. Round certs keep
+    # flowing regardless — spans are an overlay, never a liveness
+    # dependency (receivers must not WAIT on a span). None resolves from
+    # DAGRIDER_CERT_SPAN; explicit beats env, like pump/cert.
+    cert_span: Optional[int] = None
+    # Aggregator self-check before gossiping a certificate (and span):
+    # catches local corruption at the cost of one extra aggregate
+    # verify per assembly. None resolves from DAGRIDER_CERT_SELFCHECK
+    # (default on); peers verify independently either way, so turning
+    # it off trades early local detection for assembly latency.
+    cert_selfcheck: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -305,6 +332,16 @@ class Config:
         if self.cert_patience < 1:
             raise ValueError(
                 f"cert_patience must be >= 1, got {self.cert_patience}"
+            )
+        if self.cert_span is None:
+            object.__setattr__(self, "cert_span", env_int("DAGRIDER_CERT_SPAN"))
+        if self.cert_span < 0:
+            raise ValueError(
+                f"cert_span must be >= 0, got {self.cert_span}"
+            )
+        if self.cert_selfcheck is None:
+            object.__setattr__(
+                self, "cert_selfcheck", env_flag("DAGRIDER_CERT_SELFCHECK")
             )
         if self.f is None:
             object.__setattr__(self, "f", (self.n - 1) // 3)

@@ -48,6 +48,7 @@ from dag_rider_tpu.core.types import (
     Block,
     BroadcastMessage,
     RoundCertificate,
+    SpanCertificate,
     Vertex,
     VertexID,
 )
@@ -215,9 +216,32 @@ class Process:
         #: step(), after _process_inbox, so a cert can never outrun the
         #: VALs it covers through the deferred-inbox path)
         self._pending_certs: List[RoundCertificate] = []
+        # -- cert-of-certs overlay (ISSUE 12 tentpole 3) ---------------
+        #: span width k; epoch e covers rounds e*k+1 .. (e+1)*k and its
+        #: designated span aggregator is process e % n. 0 = off. Spans
+        #: ride ON TOP of round certificates: a receiver never waits on
+        #: one (liveness stays anchored on the per-round path), it only
+        #: settles still-pending covered rounds with one combined check.
+        self._span = int(cfg.cert_span or 0) if self._cert else 0
+        #: span-aggregator side: epoch -> {round: verified cert} banked
+        #: toward that epoch's cert-of-certs
+        self._span_bank: Dict[int, Dict[int, RoundCertificate]] = {}
+        #: epochs whose span we already assembled and gossiped
+        self._spans_sent: Set[int] = set()
+        #: epochs settled locally (span applied) or abandoned (a covered
+        #: round degraded / bank went stale) — later spans are ignored
+        self._span_done: Set[int] = set()
+        #: epoch -> ticks a partial bank has been waiting; stale epochs
+        #: abandon (the overlay is best-effort, certs keep flowing)
+        self._span_wait: Dict[int, int] = {}
+        #: spans received but not yet applied (same deferred application
+        #: discipline as _pending_certs)
+        self._pending_spans: List[SpanCertificate] = []
         self.metrics = Metrics()
         if self._cert:
             self.metrics.counters["cert_path_enabled"] = 1
+            if self._span:
+                self.metrics.counters["span_path_enabled"] = 1
         self._started = False
         # Burst delivery (the north-star batching shape): when True,
         # ``on_message`` only queues — the driver (Simulation pump / net
@@ -403,6 +427,8 @@ class Process:
             self._on_sync_nack(msg)
         elif msg.kind == "cert":
             self._on_certificate(msg)
+        elif msg.kind == "cert_span":
+            self._on_span(msg)
         else:
             # RBC control traffic (echo/ready/fetch) is consumed by the
             # transport/rbc.py stage; a Process only eats vertex payloads.
@@ -717,24 +743,76 @@ class Process:
         if self._started and not self.defer_steps:
             self.step()
 
+    def _on_span(self, msg: BroadcastMessage) -> None:
+        """Queue a received cert-of-certs; like round certificates,
+        application is deferred to :meth:`step`. Shape gating is strict —
+        a span must be exactly this deployment's epoch geometry."""
+        span = msg.span
+        if not self._cert or not self._span or span is None:
+            self.metrics.inc("msgs_ignored_kind")
+            return
+        k = self._span
+        if (
+            span.first_round < 1
+            or len(span.signers) != k
+            or (span.first_round - 1) % k != 0
+            or span.last_round <= self.dag.base_round
+            or (span.first_round - 1) // k in self._span_done
+        ):
+            self.metrics.inc("spans_ignored")
+            return
+        self._pending_spans.append(span)
+        if self._started and not self.defer_steps:
+            self.step()
+
     def _cert_step(self) -> bool:
-        """Apply queued certificates and assemble ours when a quorum of
-        directly verified shares is banked. Returns True when a
-        certificate admitted vertices (buffer progress)."""
+        """Apply queued span + round certificates and assemble ours when
+        enough material is banked. Returns True when anything admitted
+        vertices (buffer progress). Spans apply first so a round they
+        settle skips its (now redundant) per-round check this step."""
         progress = False
+        if self._pending_spans:
+            spans, self._pending_spans = self._pending_spans, []
+            for span in spans:
+                progress |= self._apply_span(span)
         if self._pending_certs:
             certs, self._pending_certs = self._pending_certs, []
-            for cert in certs:
-                progress |= self._apply_certificate(cert)
+            fresh: List[RoundCertificate] = []
+            seen: Set[tuple] = set()
+            for c in certs:
+                key = c.signing_key()
+                if (
+                    c.round > self.dag.base_round
+                    and c.round not in self._cert_done
+                    and key not in seen
+                ):
+                    seen.add(key)
+                    fresh.append(c)
+            # two or more live certificates in one step share ONE
+            # combined product check (verify_many), with per-cert
+            # localization when the combined check fails
+            verdicts = (
+                self.cert_verifier.verify_many(fresh)
+                if len(fresh) >= 2
+                else [None] * len(fresh)
+            )
+            for cert, ok in zip(fresh, verdicts):
+                progress |= self._apply_certificate(cert, ok)
         if self._cert_stash:
             self._maybe_assemble_certs()
+        if self._span and self._span_bank:
+            self._maybe_assemble_spans()
         return progress
 
-    def _apply_certificate(self, cert: RoundCertificate) -> bool:
+    def _apply_certificate(
+        self, cert: RoundCertificate, valid: Optional[bool] = None
+    ) -> bool:
         r = cert.round
         if r <= self.dag.base_round or r in self._cert_done:
             return False
-        if not self.cert_verifier.verify_certificate(cert):
+        if valid is None:
+            valid = self.cert_verifier.verify_certificate(cert)
+        if not valid:
             # forged aggregate / bad bitmap / substituted digests: reject
             # and fall back to per-vertex verifies for the whole round
             self.metrics.inc("certs_rejected")
@@ -742,6 +820,7 @@ class Process:
             self._degrade_cert_round(r)
             return False
         self.metrics.inc("certs_verified")
+        self._bank_span_cert(cert)
         pool = self._cert_pool.pop(r, None) or {}
         self._cert_done.add(r)
         self._cert_wait.pop(r, None)
@@ -776,11 +855,34 @@ class Process:
             for v in pool.values():
                 self._pending_verify.append(v)
                 self._pending_verify_ids.add(v.id)
+        if self._span:
+            # a degraded round's certificate will never be banked, so a
+            # partially banked epoch covering it can never complete —
+            # abandon it (the span is an overlay; nothing to degrade)
+            e = (r - 1) // self._span
+            if self._span_bank.pop(e, None) is not None:
+                self._span_wait.pop(e, None)
+                self._span_done.add(e)
 
     def _cert_tick(self) -> bool:
         """One patience tick for every round still waiting on its
         certificate; expired rounds degrade. Returns True when anything
-        degraded (there is now per-vertex work to drain)."""
+        degraded (there is now per-vertex work to drain). Partial span
+        banks age here too — k rounds' worth of patience, since an epoch
+        legitimately spans k certificate latencies."""
+        if self._span and self._span_bank:
+            stale = []
+            for e in self._span_bank:
+                w = self._span_wait.get(e, 0) + 1
+                self._span_wait[e] = w
+                if w > self.cfg.cert_patience * self._span:
+                    stale.append(e)
+            for e in stale:
+                del self._span_bank[e]
+                self._span_wait.pop(e, None)
+                self._span_done.add(e)
+                self.metrics.inc("span_timeouts")
+                self.log.event("span_timeout", epoch=e)
         if not self._cert_pool:
             return False
         patience = self.cfg.cert_patience
@@ -795,6 +897,111 @@ class Process:
             self.log.event("cert_timeout", round=r)
             self._degrade_cert_round(r)
         return bool(timed_out)
+
+    # -- cert-of-certs (ISSUE 12 tentpole 3) ---------------------------
+
+    def _bank_span_cert(self, cert: RoundCertificate) -> None:
+        """Bank a VERIFIED (or self-assembled) round certificate toward
+        its epoch's cert-of-certs — span-aggregator side only."""
+        k = self._span
+        if not k:
+            return
+        e = (cert.round - 1) // k
+        if (
+            e % self.cfg.n != self.index
+            or e in self._spans_sent
+            or e in self._span_done
+        ):
+            return
+        self._span_bank.setdefault(e, {})[cert.round] = cert
+
+    def _maybe_assemble_spans(self) -> None:
+        """Fold a fully banked epoch into one SpanCertificate and gossip
+        it. The bank is keyed by round inside the epoch's k-round window,
+        so len == k means gap-free coverage."""
+        k = self._span
+        for e in sorted(self._span_bank):
+            bank = self._span_bank[e]
+            if len(bank) < k:
+                continue
+            del self._span_bank[e]
+            self._span_wait.pop(e, None)
+            if e in self._spans_sent:
+                continue
+            self._spans_sent.add(e)
+            first = e * k + 1
+            span = self.cert_verifier.make_span(
+                first, [bank[r] for r in sorted(bank)]
+            )
+            if span is None:
+                continue
+            # pre-gossip self-check, knob-gated like the round-cert one
+            if self.cfg.cert_selfcheck and not self.cert_verifier.verify_span(
+                span
+            ):
+                continue
+            self.metrics.inc("spans_assembled")
+            self.log.event("span_assembled", first_round=first, rounds=k)
+            self.transport.broadcast(
+                BroadcastMessage(
+                    vertex=None,
+                    round=span.last_round,
+                    sender=self.index,
+                    kind="cert_span",
+                    span=span,
+                )
+            )
+
+    def _apply_span(self, span: SpanCertificate) -> bool:
+        """Settle every covered round still awaiting its certificate with
+        the span's ONE combined check. Rounds already settled (cert
+        applied, degraded, or pruned) are left alone — a span never
+        un-decides anything, and a receiver never waits for one."""
+        k = self._span
+        e = (span.first_round - 1) // k
+        if span.last_round <= self.dag.base_round or e in self._span_done:
+            return False
+        pending = [
+            r
+            for r in range(span.first_round, span.last_round + 1)
+            if r > self.dag.base_round and r not in self._cert_done
+        ]
+        if not pending:
+            self.metrics.inc("spans_ignored")
+            return False
+        if not self.cert_verifier.verify_span(span):
+            # no degradation: the per-round certificates remain the
+            # covered rounds' liveness anchor, so a bad span costs
+            # nothing but this check
+            self.metrics.inc("spans_rejected")
+            self.log.event("span_reject", first_round=span.first_round)
+            return False
+        self.metrics.inc("spans_verified")
+        self._span_done.add(e)
+        admitted = False
+        for r in pending:
+            covered = dict(
+                zip(
+                    span.signers[r - span.first_round],
+                    span.digests[r - span.first_round],
+                )
+            )
+            pool = self._cert_pool.pop(r, None) or {}
+            self._cert_done.add(r)
+            self._cert_wait.pop(r, None)
+            self.metrics.inc("span_rounds_settled")
+            for src, v in pool.items():
+                d = covered.get(src)
+                if d is not None and d == (
+                    v.__dict__.get("_digest") or v.digest()
+                ):
+                    self._admit_to_buffer(v)
+                    self.metrics.inc("sigs_saved")
+                    admitted = True
+                else:
+                    self._pending_verify.append(v)
+                    self._pending_verify_ids.add(v.id)
+        return admitted
 
     def _maybe_assemble_certs(self) -> None:
         quorum = self.cfg.quorum
@@ -815,8 +1022,14 @@ class Process:
             # verdict by certificate content, so in-process receivers'
             # checks are dict hits — the cluster pays each aggregate
             # pairing once (mirrors the simulator's dedup'd verify).
-            if not self.cert_verifier.verify_certificate(cert):
+            # Knob-gated (DAGRIDER_CERT_SELFCHECK): off trades early
+            # local-corruption detection for assembly latency; peers
+            # verify independently either way, so safety is unchanged.
+            if self.cfg.cert_selfcheck and not self.cert_verifier.verify_certificate(
+                cert
+            ):
                 continue
+            self._bank_span_cert(cert)
             self.metrics.inc("certs_assembled")
             self.log.event("cert_assembled", round=r, signers=len(cert.signers))
             self.transport.broadcast(
@@ -1722,6 +1935,26 @@ class Process:
             }
             self._cert_done = {r for r in self._cert_done if r > base}
             self._certs_sent = {r for r in self._certs_sent if r > base}
+            if self._span:
+                # epoch books retire once the epoch's last round sinks
+                # below the floor ((e+1)*k is epoch e's last round)
+                k = self._span
+                self._span_bank = {
+                    e: b
+                    for e, b in self._span_bank.items()
+                    if (e + 1) * k > base
+                }
+                self._span_wait = {
+                    e: w
+                    for e, w in self._span_wait.items()
+                    if e in self._span_bank
+                }
+                self._spans_sent = {
+                    e for e in self._spans_sent if (e + 1) * k > base
+                }
+                self._span_done = {
+                    e for e in self._span_done if (e + 1) * k > base
+                }
         # A reliable-broadcast stage keeps per-slot vote books — retire
         # them along the same floor (transport/rbc.py prune_below), or a
         # long-running RBC node leaks exactly the state class the DAG

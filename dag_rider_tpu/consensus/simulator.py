@@ -37,6 +37,7 @@ class Simulation:
         signer_factory: Optional[Callable[[int], object]] = None,
         cert: Optional[bool] = None,
         cert_msm: Optional[str] = None,
+        cert_pair: Optional[str] = None,
         rbc: bool = False,
         process_factory: Optional[Callable[..., Process]] = None,
         log=None,
@@ -77,7 +78,11 @@ class Simulation:
                 cert_signers,
                 self.cert_verifier,
             ) = self._named_verifier(
-                verifier, signer_factory, with_cert=use_cert, cert_msm=cert_msm
+                verifier,
+                signer_factory,
+                with_cert=use_cert,
+                cert_msm=cert_msm,
+                cert_pair=cert_pair,
             )
         elif use_cert:
             raise ValueError(
@@ -143,7 +148,7 @@ class Simulation:
 
     def _named_verifier(
         self, kind: str, signer_factory, *, with_cert: bool = False,
-        cert_msm: Optional[str] = None,
+        cert_msm: Optional[str] = None, cert_pair: Optional[str] = None,
     ):
         """Convenience spelling of the common cluster shapes:
         ``verifier="cpu" | "device" | "sharded"`` builds one SHARED
@@ -171,7 +176,7 @@ class Simulation:
             from dag_rider_tpu.verifier.cert import CertVerifier
 
             cert_verifier = CertVerifier(
-                reg, self.cfg.quorum, msm=cert_msm
+                reg, self.cfg.quorum, msm=cert_msm, pair=cert_pair
             )
         else:
             reg, seeds = KeyRegistry.generate(self.cfg.n)

@@ -23,6 +23,7 @@ from dag_rider_tpu.core.types import (
     Block,
     BroadcastMessage,
     RoundCertificate,
+    SpanCertificate,
     Vertex,
     VertexID,
 )
@@ -142,7 +143,61 @@ def decode_certificate(
     )
 
 
-_KINDS = ("val", "echo", "ready", "fetch", "sync", "sync_nack", "cert")
+def encode_span_certificate(span: SpanCertificate) -> bytes:
+    """Span layout: first round, round count, then each round's signer
+    count + signer u32s + parallel digest blobs, then the combined
+    aggregate signature (ISSUE 12 tentpole 3)."""
+    out = [struct.pack("<II", span.first_round, len(span.signers))]
+    for signers, digests in zip(span.signers, span.digests):
+        out.append(struct.pack("<I", len(signers)))
+        if signers:
+            out.append(struct.pack(f"<{len(signers)}I", *signers))
+        for d in digests:
+            out.append(struct.pack("<I", len(d)))
+            out.append(d)
+    out.append(struct.pack("<I", len(span.agg_sig)))
+    out.append(span.agg_sig)
+    return b"".join(out)
+
+
+def decode_span_certificate(
+    data: bytes, offset: int = 0
+) -> Tuple[SpanCertificate, int]:
+    first, k = struct.unpack_from("<II", data, offset)
+    offset += 8
+    all_signers = []
+    all_digests = []
+    for _ in range(k):
+        (count,) = struct.unpack_from("<I", data, offset)
+        offset += 4
+        signers = struct.unpack_from(f"<{count}I", data, offset)
+        offset += 4 * count
+        digests = []
+        for _ in range(count):
+            (ln,) = struct.unpack_from("<I", data, offset)
+            offset += 4
+            digests.append(data[offset : offset + ln])
+            offset += ln
+        all_signers.append(tuple(signers))
+        all_digests.append(tuple(digests))
+    (ln,) = struct.unpack_from("<I", data, offset)
+    offset += 4
+    agg = data[offset : offset + ln]
+    offset += ln
+    return (
+        SpanCertificate(
+            first_round=first,
+            signers=tuple(all_signers),
+            digests=tuple(all_digests),
+            agg_sig=agg,
+        ),
+        offset,
+    )
+
+
+_KINDS = (
+    "val", "echo", "ready", "fetch", "sync", "sync_nack", "cert", "cert_span",
+)
 
 
 def encode_message(msg: BroadcastMessage) -> bytes:
@@ -170,6 +225,13 @@ def encode_message(msg: BroadcastMessage) -> bytes:
         else:
             out.append(b"\x01")
             out.append(encode_certificate(msg.cert))
+    # likewise the span section exists only for the new cert_span kind
+    if msg.kind == "cert_span":
+        if msg.span is None:
+            out.append(b"\x00")
+        else:
+            out.append(b"\x01")
+            out.append(encode_span_certificate(msg.span))
     return b"".join(out)
 
 
@@ -196,6 +258,12 @@ def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]
         offset += 1
         if has_cert:
             cert, offset = decode_certificate(data, offset)
+    span = None
+    if kind == "cert_span":
+        has_span = data[offset]
+        offset += 1
+        if has_span:
+            span, offset = decode_span_certificate(data, offset)
     return (
         BroadcastMessage(
             vertex=v,
@@ -205,6 +273,7 @@ def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]
             origin=None if origin < 0 else origin,
             digest=digest,
             cert=cert,
+            span=span,
         ),
         offset,
     )

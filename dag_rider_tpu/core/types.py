@@ -209,6 +209,44 @@ class RoundCertificate:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpanCertificate:
+    """A cert-of-certs covering ``k`` consecutive round certificates
+    (ISSUE 12 tentpole 3).
+
+    ``signers[i]`` / ``digests[i]`` restate what the round
+    ``first_round + i`` certificate claimed, and ``agg_sig`` is the
+    compressed G1 sum of those rounds' certificate aggregates — so ONE
+    combined multi-pairing proves every (digest, pk) pair across the
+    span was signed, and a catch-up consumer pays 1/k of the per-round
+    pairing count. Deliberately slim: no embedded per-round signatures
+    (they would be unverified claims a receiver could only trust by
+    re-doing the per-round work the span exists to avoid).
+
+    Spans are an overlay on the certificate path, never a liveness
+    dependency: round certificates keep flowing per-round, and a
+    receiver that already settled a covered round just ignores the span
+    for that round.
+    """
+
+    first_round: int
+    signers: Tuple[Tuple[int, ...], ...]
+    digests: Tuple[Tuple[bytes, ...], ...]
+    agg_sig: bytes
+
+    @property
+    def last_round(self) -> int:
+        return self.first_round + len(self.signers) - 1
+
+    def signing_key(self) -> tuple:
+        """Hashable identity of the span's combined claim — the memo key
+        for the COMBINED verdict only (a passing span check does not
+        imply each component round certificate is individually valid,
+        so per-round verdicts are never derived from it)."""
+        return ("span", self.first_round, self.signers, self.digests,
+                self.agg_sig)
+
+
+@dataclasses.dataclass(frozen=True)
 class BroadcastMessage:
     """The unit the Transport carries (reference ``bcastMsg``,
     ``process/transport.go:11-18``): a vertex plus the round/sender stamps.
@@ -232,3 +270,5 @@ class BroadcastMessage:
     digest: Optional[bytes] = None
     #: aggregated round certificate, only for kind == "cert" (ISSUE 9)
     cert: Optional[RoundCertificate] = None
+    #: cert-of-certs, only for kind == "cert_span" (ISSUE 12)
+    span: Optional[SpanCertificate] = None

@@ -27,9 +27,9 @@ decisions do (SURVEY.md §7 hard part (b)).
 
 from __future__ import annotations
 
-import functools
+import collections
 import hashlib
-from typing import Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 # --- base field / curve parameters (standard BLS12-381 constants) ----------
 
@@ -815,7 +815,53 @@ def g1_decompress(data: bytes):
     return (x, y)
 
 
-@functools.lru_cache(maxsize=256)
+#: G1 cofactor-clearing multiplier h1 = (x_param - 1)^2 // 3
+_H1_COFACTOR = (X_PARAM - 1) ** 2 // 3
+
+# hash_to_g1 memo — a hand-rolled LRU (was functools.lru_cache) so the
+# batched signer can consult it without recomputing and so cache behavior
+# is observable: hit/miss totals surface in the metrics snapshot
+# (hash_g1_cache_hits / hash_g1_cache_misses, ISSUE 12 satellite).
+_H2G1_CACHE: "collections.OrderedDict[tuple, tuple]" = collections.OrderedDict()
+_H2G1_CACHE_MAX = 256
+_H2G1_STATS = {"hits": 0, "misses": 0}
+
+
+def hash_g1_cache_stats() -> dict:
+    """Process-global hash_to_g1 cache counters (cumulative)."""
+    return dict(_H2G1_STATS)
+
+
+def hash_g1_cache_clear() -> None:
+    _H2G1_CACHE.clear()
+    _H2G1_STATS["hits"] = 0
+    _H2G1_STATS["misses"] = 0
+
+
+def _h2g1_lookup(msg: bytes, domain: bytes):
+    hit = _H2G1_CACHE.get((msg, domain))
+    if hit is not None:
+        _H2G1_CACHE.move_to_end((msg, domain))
+        _H2G1_STATS["hits"] += 1
+        return hit
+    _H2G1_STATS["misses"] += 1
+    return None
+
+
+def _h2g1_store(msg: bytes, domain: bytes, pt: tuple) -> None:
+    if len(_H2G1_CACHE) >= _H2G1_CACHE_MAX:
+        _H2G1_CACHE.popitem(last=False)
+    _H2G1_CACHE[(msg, domain)] = pt
+
+
+def _hash_candidate_x(msg: bytes, domain: bytes, ctr: int) -> int:
+    """The try-and-increment field candidate H(domain || ctr || msg) mod p
+    — the per-row host half of the split map (SHA stays on host, the
+    square-root/ladder half batches on a backend)."""
+    h = hashlib.sha512(domain + ctr.to_bytes(4, "little") + msg).digest()
+    return int.from_bytes(h, "big") % P
+
+
 def hash_to_g1(msg: bytes, domain: bytes = b"dagrider-coin-v1") -> tuple:
     """Try-and-increment hash onto the r-torsion of E(Fp).
 
@@ -829,21 +875,20 @@ def hash_to_g1(msg: bytes, domain: bytes = b"dagrider-coin-v1") -> tuple:
     in a committee; bounded cache — tags are per-wave, 256 covers any
     live window many times over).
     """
+    hit = _h2g1_lookup(msg, domain)
+    if hit is not None:
+        return hit
     ctr = 0
     while True:
-        h = hashlib.sha512(
-            domain + ctr.to_bytes(4, "little") + msg
-        ).digest()
-        x = int.from_bytes(h, "big") % P
+        x = _hash_candidate_x(msg, domain, ctr)
         y2 = (x * x * x + 4) % P
         y = pow(y2, (P + 1) // 4, P)
         if y * y % P == y2:
             y = min(y, P - y)
             pt = (x, y)
-            # clear cofactor: h1 = (x_param - 1)^2 // 3
-            h1 = (X_PARAM - 1) ** 2 // 3
-            cleared = _ec_mul_raw(_FP_OPS, h1, pt)
+            cleared = _ec_mul_raw(_FP_OPS, _H1_COFACTOR, pt)
             if cleared is not None:
+                _h2g1_store(msg, domain, cleared)
                 return cleared
         ctr += 1
 
@@ -930,6 +975,121 @@ def g2_deserialize(data: bytes):
 def sign(sk: int, msg: bytes) -> bytes:
     """sigma = sk * H(msg) in G1, compressed to 48 bytes."""
     return g1_compress(g1_mul(sk, hash_to_g1(msg)))
+
+
+def _sign_many_via(
+    pow_p_batch,
+    ladder_batch,
+    sks: Sequence[int],
+    msgs: Sequence[bytes],
+    domain: bytes,
+) -> List[bytes]:
+    """Round-batched signing over two backend primitives.
+
+    The merged-scalar trick: the oracle computes [sk % R]([h1]candidate)
+    in two stages (cofactor clearing inside hash_to_g1, then the signing
+    mul); one ladder over the merged scalar (sk % R) * h1 gives the same
+    group element in a single pass — [ab]Q == [a]([b]Q) in any abelian
+    group, and both ladders are exact mod-p arithmetic. [h1]candidate is
+    the identity iff the merged result is (sk % R is nonzero and [h1]Q
+    has order r or 1), which is exactly the case where the oracle retries
+    the next hash candidate — those rows (and any backend-flagged rows)
+    fall back to the sequential host `sign`, keeping byte-identity on
+    every input.
+    """
+    out: List[Optional[bytes]] = [None] * len(msgs)
+    scalars: List[int] = []
+    points: List[Tuple[int, int]] = []
+    idxs: List[int] = []
+    pend: List[list] = []  # [out_index, sk_mod_r, msg, ctr]
+    for i, (sk, msg) in enumerate(zip(sks, msgs)):
+        skr = sk % R
+        if skr == 0:
+            out[i] = g1_compress(None)
+            continue
+        hit = _h2g1_lookup(msg, domain)
+        if hit is not None:
+            scalars.append(skr)
+            points.append(hit)
+            idxs.append(i)
+        else:
+            pend.append([i, skr, msg, 0])
+    # try-and-increment with the square-root power map batched: every
+    # unresolved row advances its counter in lockstep (~2 rounds expected;
+    # each candidate is square with probability 1/2)
+    while pend:
+        xs = [_hash_candidate_x(m, domain, ctr) for (_, _, m, ctr) in pend]
+        y2s = [(x * x * x + 4) % P for x in xs]
+        ys = pow_p_batch(y2s, (P + 1) // 4)
+        nxt = []
+        for row, x, y2, y in zip(pend, xs, y2s, ys):
+            if y * y % P == y2:
+                y = min(y, P - y)
+                scalars.append(row[1] * _H1_COFACTOR)
+                points.append((x, y))
+                idxs.append(row[0])
+            else:
+                row[3] += 1
+                nxt.append(row)
+        pend = nxt
+    if scalars:
+        results, fallback = ladder_batch(scalars, points)
+    else:
+        results, fallback = [], []
+    for i, res, fb in zip(idxs, results, fallback):
+        if fb or res is None:
+            out[i] = g1_compress(g1_mul(sks[i], hash_to_g1(msgs[i], domain)))
+        else:
+            out[i] = g1_compress(res)
+    return out  # type: ignore[return-value]
+
+
+def sign_many(
+    sks: Sequence[int],
+    msgs: Sequence[bytes],
+    domain: bytes = b"dagrider-coin-v1",
+    backend: Optional[str] = None,
+) -> List[bytes]:
+    """Batched `sign` — byte-for-byte [sign(sk, m) for sk, m in zip(...)].
+
+    Backend (explicit arg beats the DAGRIDER_CERT_SIGN knob):
+
+    - ``host``: the sequential oracle (default);
+    - ``native``: cffi C Montgomery kernels (ops/native381.py) — the
+      single-core fast lane (falls back to host when no toolchain);
+    - ``device``: the field381 limb-kernel lane (ops/bls_g1.py) — the
+      real-chip story, bit-identical everywhere.
+    """
+    sks = list(sks)
+    msgs = list(msgs)
+    if len(sks) != len(msgs):
+        raise ValueError("sign_many: sks and msgs length mismatch")
+    if backend is None:
+        from dag_rider_tpu import config
+
+        backend = config.env_choice("DAGRIDER_CERT_SIGN")
+    if backend == "native" and msgs:
+        from dag_rider_tpu.ops import native381
+
+        if native381.available():
+            return _sign_many_via(
+                native381.pow_p_batch,
+                native381.g1_ladder_batch,
+                sks,
+                msgs,
+                domain,
+            )
+        backend = "host"
+    if backend == "device" and msgs:
+        from dag_rider_tpu.ops import bls_g1
+
+        return _sign_many_via(
+            bls_g1.pow_p_batch, bls_g1.g1_ladder_batch, sks, msgs, domain
+        )
+    return [
+        g1_compress(g1_mul(sk, hash_to_g1(m, domain)))
+        for sk, m in zip(sks, msgs)
+    ]
 
 
 def pk_of(sk: int):

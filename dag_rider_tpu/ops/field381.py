@@ -56,6 +56,24 @@ def to_limbs(x: int) -> np.ndarray:
     return _strict_limbs(x, LIMBS)
 
 
+def to_limbs_bulk(vals) -> np.ndarray:
+    """Host helper: sequence of ints in [0, 2^396) -> int32[n, 33].
+    Vectorized via byte unpacking — the per-int :func:`to_limbs` loop is
+    the marshalling bottleneck at multi-pairing sizes (257 pairs x 12
+    coefficients x 68 schedule slots)."""
+    n = len(vals)
+    if n == 0:
+        return np.zeros((0, LIMBS), dtype=np.int32)
+    raw = np.frombuffer(
+        b"".join(int(v).to_bytes(50, "little") for v in vals), dtype=np.uint8
+    ).reshape(n, 50)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")[:, : LIMBS * LIMB_BITS]
+    weights = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(np.int32)
+    return (
+        bits.reshape(n, LIMBS, LIMB_BITS).astype(np.int32) * weights
+    ).sum(axis=-1, dtype=np.int32)
+
+
 def from_limbs(limbs) -> int:
     """Host helper: limb vector -> python int (signed limbs allowed)."""
     arr = np.asarray(limbs, dtype=np.int64)

@@ -79,6 +79,23 @@ def save(process, path: str, *, mempool=None) -> None:
             [tx.hex() for tx in b.transactions]
             for b in process.blocks_to_propose
         ],
+        # Certificate-path books (ISSUE 12): settled rounds must survive
+        # a restart or a resumed aggregator would re-gossip certificates
+        # (harmless but noisy) and a resumed receiver would re-pool
+        # settled rounds. Banked span certs ride the canonical cert
+        # codec as hex so a mid-epoch span aggregator resumes banking
+        # instead of silently abandoning the epoch. Absent in older
+        # manifests -> empty defaults.
+        "cert_done": sorted(process._cert_done),
+        "certs_sent": sorted(process._certs_sent),
+        "spans_sent": sorted(process._spans_sent),
+        "span_done": sorted(process._span_done),
+        "span_bank": {
+            str(e): [
+                codec.encode_certificate(bank[r]).hex() for r in sorted(bank)
+            ]
+            for e, bank in process._span_bank.items()
+        },
         "metrics": process.metrics.snapshot(),
     }
     tmp = os.path.join(path, MANIFEST + ".tmp")
@@ -193,6 +210,18 @@ def restore(process, path: str, *, mempool=None) -> None:
         process.blocks_to_propose.append(
             Block(tuple(bytes.fromhex(tx) for tx in txs))
         )
+    process._cert_done = set(manifest.get("cert_done", []))
+    process._certs_sent = set(manifest.get("certs_sent", []))
+    process._spans_sent = set(manifest.get("spans_sent", []))
+    process._span_done = set(manifest.get("span_done", []))
+    span_bank = {}
+    for e, rows in manifest.get("span_bank", {}).items():
+        bank = {}
+        for hx in rows:
+            c, _ = codec.decode_certificate(bytes.fromhex(hx))
+            bank[c.round] = c
+        span_bank[int(e)] = bank
+    process._span_bank = span_bank
     if mempool is not None:
         mp_path = os.path.join(path, MEMPOOL)
         if os.path.exists(mp_path):

@@ -63,6 +63,16 @@ KNOWN_COUNTERS = frozenset(
         "cert_rounds_degraded",
         "cert_timeouts",
         "cert_path_enabled",
+        # cert-of-certs overlay + hash-to-curve cache (ISSUE 12)
+        "spans_assembled",
+        "spans_verified",
+        "spans_rejected",
+        "spans_ignored",
+        "span_rounds_settled",
+        "span_timeouts",
+        "span_path_enabled",
+        "hash_g1_cache_hits",
+        "hash_g1_cache_misses",
         # transport/net.py — wire health
         "net_sends",
         "net_sends_ok",
@@ -420,6 +430,27 @@ class Metrics:
             out["cert_fastpath_fraction"] = round(
                 self.counters.get("sigs_saved", 0) / admitted, 4
             ) if admitted else 0.0
+            # hash-to-curve cache effectiveness (ISSUE 12 satellite):
+            # process-global by construction (the cache lives in the
+            # crypto layer), surfaced as gauges wherever the cert path
+            # is on so a bench run can see its hit rate next to the
+            # signing numbers. Lazy import keeps cert-off snapshots free
+            # of the BLS module.
+            from dag_rider_tpu.crypto import bls12381 as _bls
+
+            h2g1 = _bls.hash_g1_cache_stats()
+            out["hash_g1_cache_hits"] = h2g1["hits"]
+            out["hash_g1_cache_misses"] = h2g1["misses"]
+            if "span_path_enabled" in self.counters:
+                for k in (
+                    "spans_assembled",
+                    "spans_verified",
+                    "spans_rejected",
+                    "spans_ignored",
+                    "span_rounds_settled",
+                    "span_timeouts",
+                ):
+                    out.setdefault(k, 0)
         if self.wave_commit_seconds:
             out["wave_commit_p50_ms"] = 1e3 * self._p50(self.wave_commit_seconds)
         if self.wave_interval_seconds:

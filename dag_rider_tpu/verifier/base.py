@@ -134,6 +134,16 @@ class CertSigner:
 
         return bls.sign(self._sk, digest)
 
+    def sign_digests(self, digests: Sequence[bytes]) -> List[bytes]:
+        """Round-batched share signing (ISSUE 12 tentpole 1): one
+        :func:`bls12381.sign_many` call amortizes the hash-to-curve field
+        maps and scalar ladders across every digest, routed by
+        DAGRIDER_CERT_SIGN. Byte-identical to mapping
+        :meth:`sign_digest` — tests/test_cert_phase2.py pins it."""
+        from dag_rider_tpu.crypto import bls12381 as bls
+
+        return bls.sign_many([self._sk] * len(digests), digests)
+
 
 class VerifierUnavailableError(RuntimeError):
     """A verifier backend could not be reached or could not complete an

@@ -32,7 +32,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence, Tuple
 
 from dag_rider_tpu import config
-from dag_rider_tpu.core.types import RoundCertificate
+from dag_rider_tpu.core.types import RoundCertificate, SpanCertificate
 from dag_rider_tpu.crypto import bls12381 as bls
 from dag_rider_tpu.verifier.base import KeyRegistry
 
@@ -50,6 +50,17 @@ def _resolve_msm(msm: Optional[str]) -> str:
     return choice
 
 
+def _resolve_pair(pair: Optional[str]) -> str:
+    choice = (
+        pair if pair is not None else config.env_choice("DAGRIDER_CERT_PAIR")
+    )
+    if choice not in ("host", "device"):
+        raise ValueError(
+            f'cert pairing must be "host" or "device", got {choice!r}'
+        )
+    return choice
+
+
 class CertVerifier:
     """Validates :class:`RoundCertificate`\\ s against a key registry and
     aggregates signature shares for the assembling side.
@@ -60,6 +71,10 @@ class CertVerifier:
         msm: "host" (group-law fallback) | "device" (ops/bls_msm kernel)
             | "sharded" (parallel/msm over the mesh); None reads
             DAGRIDER_CERT_MSM, defaulting to host.
+        pair: "host" (crypto/bls12381 Miller replay) | "device"
+            (ops/bls_pairing lane-parallel line evaluations); None reads
+            DAGRIDER_CERT_PAIR, defaulting to host. Bit-identical
+            verdicts by construction (ISSUE 12 tentpole 2).
     """
 
     def __init__(
@@ -67,6 +82,7 @@ class CertVerifier:
         registry: KeyRegistry,
         quorum: int,
         msm: Optional[str] = None,
+        pair: Optional[str] = None,
     ) -> None:
         if not registry.bls_public_keys:
             raise ValueError(
@@ -76,6 +92,7 @@ class CertVerifier:
         self.registry = registry
         self.quorum = int(quorum)
         self.msm = _resolve_msm(msm)
+        self.pair = _resolve_pair(pair)
         self._sharded = None
         self._verdicts: dict = {}
         self.stats = {
@@ -83,6 +100,7 @@ class CertVerifier:
             "certs_valid": 0,
             "certs_invalid": 0,
             "verdict_hits": 0,
+            "pairing_checks": 0,
         }
 
     # -- aggregation (the assembling side) ------------------------------
@@ -162,16 +180,170 @@ class CertVerifier:
         self.stats["certs_valid" if ok else "certs_invalid"] += 1
         return ok
 
-    def _check(self, cert: RoundCertificate) -> bool:
+    def _pairing_check(self, pairs: Sequence[tuple]) -> bool:
+        """Route one product check through the pairing seam; the counter
+        is what the span path's <1-check-per-round claim is measured on
+        (bench.py cert_phase2 rung)."""
+        self.stats["pairing_checks"] += 1
+        if self.pair == "device":
+            from dag_rider_tpu.ops import bls_pairing
+
+            return bls_pairing.multi_pairing_check(pairs)
+        return bls.multi_pairing_check(pairs)
+
+    def _cert_pairs(self, cert: RoundCertificate) -> Optional[List[tuple]]:
+        """The certificate's product-check pair list
+        ``[(agg, -G2)] + [(H(d_i), pk_i) ...]``, or None for any
+        structural defect (bad bitmap, unknown signer, bad point)."""
         if not self._structurally_valid(cert):
-            return False
+            return None
         agg = bls.g1_decompress(cert.agg_sig)
         if agg is None:
-            return False
+            return None
         pairs: List[tuple] = [(agg, bls.g2_neg(bls.G2_GEN))]
         for src, digest in zip(cert.signers, cert.digests):
             pk = self.registry.bls_key_of(src)
             if pk is None:
-                return False
+                return None
             pairs.append((bls.hash_to_g1(digest), pk))
-        return bls.multi_pairing_check(pairs)
+        return pairs
+
+    def _check(self, cert: RoundCertificate) -> bool:
+        pairs = self._cert_pairs(cert)
+        if pairs is None:
+            return False
+        return self._pairing_check(pairs)
+
+    def verify_many(self, certs: Sequence[RoundCertificate]) -> List[bool]:
+        """Batched receiver-side verification: every pending certificate's
+        pair list merges into ONE combined product check.
+
+        A combined pass is sound for *admission* — by aggregate
+        unforgeability every claimed (digest, pk) pair across the batch
+        was signed — but it does NOT prove each component certificate
+        individually well-formed (offsetting defects cancel in the
+        product), so only the combined verdict is memoized, keyed by the
+        sorted member identities. Per-cert verdicts come from
+        :meth:`verify_certificate` on the localization path when the
+        combined check fails."""
+        verdicts: List[Optional[bool]] = []
+        fresh: List[int] = []
+        for i, cert in enumerate(certs):
+            hit = self._verdicts.get(cert.signing_key())
+            if hit is not None:
+                self.stats["certs_checked"] += 1
+                self.stats["verdict_hits"] += 1
+                verdicts.append(hit)
+            else:
+                verdicts.append(None)
+                fresh.append(i)
+        if len(fresh) < 2:
+            for i in fresh:
+                verdicts[i] = self.verify_certificate(certs[i])
+            return [bool(v) for v in verdicts]
+        combined_key = ("many",) + tuple(
+            sorted(certs[i].signing_key() for i in fresh)
+        )
+        if self._verdicts.get(combined_key):
+            # combined verdicts are only ever memoized True
+            for i in fresh:
+                self.stats["certs_checked"] += 1
+                self.stats["verdict_hits"] += 1
+                verdicts[i] = True
+            return [bool(v) for v in verdicts]
+        pair_lists = [self._cert_pairs(certs[i]) for i in fresh]
+        if all(pl is not None for pl in pair_lists):
+            all_pairs: List[tuple] = []
+            for pl in pair_lists:
+                all_pairs.extend(pl)  # type: ignore[arg-type]
+            if self._pairing_check(all_pairs):
+                if len(self._verdicts) >= _VERDICT_CACHE_MAX:
+                    self._verdicts.clear()
+                self._verdicts[combined_key] = True
+                for i in fresh:
+                    self.stats["certs_checked"] += 1
+                    self.stats["certs_valid"] += 1
+                    verdicts[i] = True
+                return [bool(v) for v in verdicts]
+        # a structural defect or a failed combined product: localize with
+        # individual (memoized) checks — identical verdicts to the oracle
+        for i in fresh:
+            verdicts[i] = self.verify_certificate(certs[i])
+        return [bool(v) for v in verdicts]
+
+    # -- cert-of-certs (ISSUE 12 tentpole 3) ----------------------------
+
+    def make_span(
+        self, first_round: int, certs: Sequence[RoundCertificate]
+    ) -> Optional[SpanCertificate]:
+        """Fold consecutive VERIFIED round certificates into one
+        cert-of-certs: the span aggregate is the G1 sum of the round
+        aggregates (through the same MSM seam as share aggregation), so
+        one combined pairing covers every (digest, pk) pair in the span.
+        Returns None unless the certs cover exactly ``first_round,
+        first_round + 1, ...`` gap-free."""
+        if not certs:
+            return None
+        rounds = [c.round for c in certs]
+        if rounds != list(range(first_round, first_round + len(certs))):
+            return None
+        points = []
+        for c in certs:
+            pt = bls.g1_decompress(c.agg_sig)
+            if pt is None:
+                return None
+            points.append(pt)
+        agg = bls.g1_compress(self._sum_points(points))
+        return SpanCertificate(
+            first_round=first_round,
+            signers=tuple(c.signers for c in certs),
+            digests=tuple(c.digests for c in certs),
+            agg_sig=agg,
+        )
+
+    def _span_structurally_valid(self, span: SpanCertificate) -> bool:
+        k = len(span.signers)
+        if k < 1 or len(span.digests) != k or span.first_round < 1:
+            return False
+        for s, d in zip(span.signers, span.digests):
+            if len(s) < self.quorum or len(s) != len(d):
+                return False
+            if any(b <= a for a, b in zip(s, s[1:])):
+                return False
+            if s[0] < 0 or s[-1] >= self.registry.n:
+                return False
+        return True
+
+    def verify_span(self, span: SpanCertificate) -> bool:
+        """ONE combined product check for the whole span — the
+        steady-state consumer pays 1/k pairing checks per round. False
+        for any defect, never an exception; the verdict is memoized
+        under the span's own tagged key (never folded back into
+        per-round verdicts — see :meth:`verify_many` on why)."""
+        self.stats["certs_checked"] += 1
+        key = span.signing_key()
+        hit = self._verdicts.get(key)
+        if hit is not None:
+            self.stats["verdict_hits"] += 1
+            return hit
+        ok = self._span_check(span)
+        if len(self._verdicts) >= _VERDICT_CACHE_MAX:
+            self._verdicts.clear()
+        self._verdicts[key] = ok
+        self.stats["certs_valid" if ok else "certs_invalid"] += 1
+        return ok
+
+    def _span_check(self, span: SpanCertificate) -> bool:
+        if not self._span_structurally_valid(span):
+            return False
+        agg = bls.g1_decompress(span.agg_sig)
+        if agg is None:
+            return False
+        pairs: List[tuple] = [(agg, bls.g2_neg(bls.G2_GEN))]
+        for signers, digests in zip(span.signers, span.digests):
+            for src, digest in zip(signers, digests):
+                pk = self.registry.bls_key_of(src)
+                if pk is None:
+                    return False
+                pairs.append((bls.hash_to_g1(digest), pk))
+        return self._pairing_check(pairs)
