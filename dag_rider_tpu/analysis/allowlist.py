@@ -1,6 +1,11 @@
 """The driderlint allowlist: every entry is a triaged, justified
 exception. An entry that stops matching anything FAILS the run (see
 core.apply_allowlist) — excuses don't outlive their violations.
+
+Round 16 emptied it: the last entry (slog.py's bare ``time.time()``
+event stamp) was fixed at the source by injecting the clock into
+``EventLog``, the same convention the round-14 transport wall-clock
+injection set.
 """
 
 from __future__ import annotations
@@ -9,15 +14,4 @@ from typing import List
 
 from dag_rider_tpu.analysis.core import Allow
 
-ALLOWS: List[Allow] = [
-    Allow(
-        checker="determinism",
-        path="dag_rider_tpu/utils/slog.py",
-        contains="time.time()",
-        reason=(
-            "structured-log event timestamps are observability metadata "
-            "read by humans and log shippers; they never feed consensus "
-            "state, ordering, or any A/B-compared output"
-        ),
-    ),
-]
+ALLOWS: List[Allow] = []

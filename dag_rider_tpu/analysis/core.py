@@ -116,6 +116,7 @@ def run_static(
     from dag_rider_tpu.analysis import (
         allowlist,
         determinism,
+        events,
         jitpure,
         knobs,
         metricsreg,
@@ -125,7 +126,7 @@ def run_static(
     if files is None:
         files = discover(repo_root)
     findings: List[Finding] = []
-    for checker in (knobs, determinism, oracle, jitpure, metricsreg):
+    for checker in (knobs, determinism, oracle, jitpure, metricsreg, events):
         findings.extend(checker.run(files, repo_root))
     bad_allows = [a for a in allowlist.ALLOWS if not a.reason.strip()]
     kept, suppressed, unused = apply_allowlist(findings, allowlist.ALLOWS)

@@ -121,6 +121,17 @@ _register("DAGRIDER_CERT_SELFCHECK", "flag", True,
           "aggregator self-verifies certificates before gossip")
 _register("DAGRIDER_CERT2_OUT", "str", "BENCH_r07.json",
           "certificate-phase-2 bench output path")
+_register("DAGRIDER_TRACE", "flag", False,
+          "causal tracing layer (ring recorder + lifecycle/phase spans)")
+_register("DAGRIDER_TRACE_SAMPLE", "float", 1.0,
+          "fraction of transactions stamped with lifecycle spans",
+          minimum=0)
+_register("DAGRIDER_TRACE_RING", "int", 65536,
+          "trace ring-buffer capacity in events", minimum=1)
+_register("DAGRIDER_FLIGHT_DIR", "str", "",
+          "flight-recorder dump directory (empty disables dumps)")
+_register("DAGRIDER_FLIGHT_EVENTS", "int", 4096,
+          "events retained in the flight-recorder last-N ring", minimum=1)
 
 
 def _raw(name: str) -> str:

@@ -36,6 +36,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from dag_rider_tpu.utils.slog import NOOP, EventLog
+
 #: one delivery record: (round, source, payload digest)
 Record = Tuple[int, int, bytes]
 
@@ -180,9 +182,19 @@ class InvariantMonitor:
     broke safety, with the offending vertex in hand, instead of a
     post-mortem diff over full logs."""
 
-    def __init__(self, n: int, exclude: Iterable[int] = ()) -> None:
+    def __init__(
+        self,
+        n: int,
+        exclude: Iterable[int] = (),
+        log: EventLog = NOOP,
+    ) -> None:
         self.n = n
         self.exclude = frozenset(exclude)
+        #: obs seam: an "invariant_violation" event fires just before
+        #: each raise — the flight recorder's trigger watch sees it and
+        #: dumps the post-mortem even though the exception unwinds past
+        #: any in-band handler
+        self.log = log
         #: canonical record sequence: position k holds the first record
         #: any honest view delivered at log position k
         self._canon: List[Record] = []
@@ -201,31 +213,45 @@ class InvariantMonitor:
         slot = rec[:2]
         slots = self._seen_slots.setdefault(view, set())
         if slot in slots:
-            raise InvariantViolation(
+            raise self._violation(
+                view,
+                "double_delivery",
                 f"p{view} delivered slot (round={rec[0]}, "
-                f"source={rec[1]}) twice"
+                f"source={rec[1]}) twice",
             )
         slots.add(slot)
         prev = self._committed.get(slot)
         if prev is None:
             self._committed[slot] = (view, rec[2])
         elif prev[1] != rec[2]:
-            raise InvariantViolation(
+            raise self._violation(
+                view,
+                "equivocation_commit",
                 f"equivocation committed: slot (round={rec[0]}, "
                 f"source={rec[1]}) delivered as {prev[1]!r} at "
-                f"p{prev[0]} but {rec[2]!r} at p{view}"
+                f"p{prev[0]} but {rec[2]!r} at p{view}",
             )
         pos = self._cursor.get(view, 0)
         if pos < len(self._canon):
             if self._canon[pos] != rec:
-                raise InvariantViolation(
+                raise self._violation(
+                    view,
+                    "order_divergence",
                     f"order divergence at p{view} position {pos}: "
-                    f"{self._canon[pos]} vs {rec}"
+                    f"{self._canon[pos]} vs {rec}",
                 )
         else:
             self._canon.append(rec)
         self._cursor[view] = pos + 1
         self.observed += 1
+
+    def _violation(
+        self, view: int, kind: str, detail: str
+    ) -> "InvariantViolation":
+        self.log.event(
+            "invariant_violation", view=view, kind=kind, detail=detail
+        )
+        return InvariantViolation(detail)
 
     def wrap(self, view: int, callback: Optional[callable]):
         """Compose the monitor in front of an existing a_deliver

@@ -52,6 +52,7 @@ from dag_rider_tpu.core.types import (
     Vertex,
     VertexID,
 )
+from dag_rider_tpu.obs import block_key
 from dag_rider_tpu.transport.base import Transport, resolve_unicast
 from dag_rider_tpu.utils.metrics import Metrics, Timer
 from dag_rider_tpu.utils.slog import NOOP, EventLog
@@ -851,6 +852,9 @@ class Process:
         self._cert_done.add(r)
         self._cert_wait.pop(r, None)
         self.metrics.inc("cert_rounds_degraded")
+        self.log.event(
+            "cert_degraded", round=r, pooled=len(pool) if pool else 0
+        )
         if pool:
             for v in pool.values():
                 self._pending_verify.append(v)
@@ -1062,7 +1066,14 @@ class Process:
             if self._inbox:
                 self._process_inbox()
             if self._cert:
-                progress |= self._cert_step()
+                if self.log.enabled:
+                    t0 = _time.perf_counter()
+                    progress |= self._cert_step()
+                    self.log.event(
+                        "phase_cert", dur_s=_time.perf_counter() - t0
+                    )
+                else:
+                    progress |= self._cert_step()
             self._drain_verify()
             progress |= self._drain_buffer()
             progress |= self._try_advance()
@@ -1086,6 +1097,7 @@ class Process:
             return self._drain_buffer_vector()
         admitted_any = False
         changed = True
+        log_admit = self.log.wants("admit")
         present = self.dag.present
         # Short-circuit memo: the first still-missing predecessor seen for
         # each blocked vertex. While that one vertex is absent the full
@@ -1205,9 +1217,10 @@ class Process:
                     self._remove_from_buffer(v.id)
                     self.dag.insert(v)
                     self.metrics.inc("vertices_admitted")
-                    self.log.event(
-                        "admit", round=v.round, source=v.source
-                    )
+                    if log_admit:
+                        self.log.event(
+                            "admit", round=v.round, source=v.source
+                        )
                     changed = True
                     admitted_any = True
             self._buffer = keep
@@ -1235,7 +1248,7 @@ class Process:
         n = self.cfg.n
         vertices = dag.vertices
         metrics_inc = self.metrics.inc
-        log_on = self.log.enabled
+        log_on = self.log.wants("admit")
         for r in sorted(groups):
             if r > self.round:
                 continue  # future round: stays buffered (process.go:203)
@@ -1377,6 +1390,16 @@ class Process:
             self.metrics.inc("rounds_advanced")
             self.log.event("round_advance", round=self.round)
             v = self._create_vertex(self.round)
+            if self.log.enabled and v.block.transactions:
+                # causal lifecycle stamp: this block (joined by payload
+                # crc in the mempool's tx_batch events) now rides the
+                # (round, source) vertex the tx_deliver stamp names
+                self.log.event(
+                    "tx_propose",
+                    block=block_key(v.block.encode()),
+                    round=self.round,
+                    source=self.index,
+                )
             self.dag.insert(v)
             self._note_seen(v)
             if (
@@ -2035,6 +2058,7 @@ class Process:
         runs, it calls the client callback, and delivered vertices are
         skipped exactly once)."""
         n_before = len(self.delivered_log)
+        trace = self.log.enabled
         dmask = self._delivered_mask
         if dmask.shape[0] < self.dag.exists.shape[0]:
             grown = np.zeros_like(self.dag.exists)
@@ -2094,6 +2118,18 @@ class Process:
                         log_append(v.id)
                         if cb is not None:
                             cb(v)
+                        if (
+                            trace
+                            and src == self.index
+                            and v.block.transactions
+                        ):
+                            # the proposer's own delivery closes the
+                            # lifecycle chain opened by tx_propose
+                            self.log.event(
+                                "tx_deliver",
+                                round=rr + lo_round,
+                                source=src,
+                            )
                 continue
             for rr, src in np.argwhere(fresh):
                 vid = VertexID(int(rr) + lo_round, int(src))
@@ -2102,6 +2138,12 @@ class Process:
                 self.metrics.inc("vertices_delivered")
                 if self.on_deliver is not None:
                     self.on_deliver(self.dag.vertices[vid])
+                if trace and vid.source == self.index:
+                    v = self.dag.vertices[vid]
+                    if v.block.transactions:
+                        self.log.event(
+                            "tx_deliver", round=vid.round, source=vid.source
+                        )
         self.log.event(
             "delivered",
             count=len(self.delivered_log) - n_before,

@@ -271,31 +271,47 @@ def run_scenario(sc: Scenario) -> dict:
         tp.flush_delayed()
         sim.run(max_messages=2 * chunk)
 
-    logs = {i: inv.delivery_records(sim.deliveries[i]) for i in honest}
-    inv.check_agreement(logs)
-    inv.check_commit_uniqueness(logs)
+    # Post-hoc audits raise InvariantViolation directly (no delivery
+    # callback to hook); route them through the event stream so the
+    # flight recorder — when tracing is on — dumps its last-N ring and
+    # metrics snapshots before the exception propagates. The ONLINE
+    # monitor needs no such wrapper: it emits invariant_violation at the
+    # offending delivery, which the flight sink auto-dumps on.
+    try:
+        logs = {i: inv.delivery_records(sim.deliveries[i]) for i in honest}
+        inv.check_agreement(logs)
+        inv.check_commit_uniqueness(logs)
 
-    retained: set = set()
-    for i in honest:
-        p = sim.processes[i]
-        for b in p.blocks_to_propose:
-            retained.update(b.transactions)
-        for v in p.dag.vertices.values():
-            retained.update(v.block.transactions)
-    audit = inv.transaction_audit(
-        accepted,
-        (
-            (tx for v in sim.deliveries[i] for tx in v.block.transactions)
-            for i in honest
-        ),
-        retained,
-    )
-    inv.check_zero_loss(audit)
+        retained: set = set()
+        for i in honest:
+            p = sim.processes[i]
+            for b in p.blocks_to_propose:
+                retained.update(b.transactions)
+            for v in p.dag.vertices.values():
+                retained.update(v.block.transactions)
+        audit = inv.transaction_audit(
+            accepted,
+            (
+                (tx for v in sim.deliveries[i] for tx in v.block.transactions)
+                for i in honest
+            ),
+            retained,
+        )
+        inv.check_zero_loss(audit)
 
-    decided = {i: sim.processes[i].decided_wave for i in honest}
-    inv.check_liveness(
-        decided, min_max=sc.min_waves, min_each=sc.min_each
-    )
+        decided = {i: sim.processes[i].decided_wave for i in honest}
+        inv.check_liveness(
+            decided, min_max=sc.min_waves, min_each=sc.min_each
+        )
+    except inv.InvariantViolation as e:
+        if sim.log.enabled:
+            sim.log.event(
+                "invariant_violation",
+                view="posthoc",
+                kind="audit",
+                detail=str(e)[:500],
+            )
+        raise
 
     def _counter(name: str) -> int:
         return sum(
@@ -306,6 +322,9 @@ def run_scenario(sc: Scenario) -> dict:
     for b in behaviors.values():
         for k, v in b.stats.items():
             behavior_stats[k] = behavior_stats.get(k, 0) + v
+    flight_dumps = (
+        [str(p) for p in sim.flight.dumps] if sim.flight is not None else []
+    )
     return {
         "name": sc.name,
         "n": cfg.n,
@@ -340,6 +359,7 @@ def run_scenario(sc: Scenario) -> dict:
         "behavior": behavior_stats,
         "transport": dict(tp.stats),
         "monitor": monitor.stats(),
+        "flight_dumps": flight_dumps,
         "invariants": {
             "agreement": True,
             "commit_uniqueness": True,

@@ -13,6 +13,7 @@ import random
 import time
 from typing import Callable, List, Optional
 
+from dag_rider_tpu import obs
 from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus.coin import CommonCoin
 from dag_rider_tpu.consensus.process import Process
@@ -90,6 +91,20 @@ class Simulation:
                 '"sharded") so the shared registry carries BLS keys'
             )
         self.transport = transport if transport is not None else InMemoryTransport()
+        # Causal tracing (ISSUE 13, DAGRIDER_TRACE): when the caller
+        # brought no log and the knob is on, install the obs bundle —
+        # ring recorder + flight-recorder trigger watch tee'd into one
+        # EventLog handed to every process. An explicit log= always
+        # wins (tests capture events their own way).
+        self.tracing = None
+        self.recorder = None
+        self.flight = None
+        if log is None and obs.trace_enabled():
+            self.tracing = obs.build_tracing()
+            self.recorder = self.tracing.recorder
+            self.flight = self.tracing.flight
+            log = self.tracing.log
+        self.log = log if log is not None else NOOP
         self.deliveries: List[List[Vertex]] = [[] for _ in range(cfg.n)]
         #: depth-K dispatch window over the shared verifier, built lazily
         #: by run() and kept across run() calls so the window/overlap
@@ -129,6 +144,12 @@ class Simulation:
                 )
             )
         self._rbc = rbc
+        if self.flight is not None:
+            # a dump captures every process's full counter state
+            for p in self.processes:
+                self.flight.add_metrics_source(
+                    str(p.index), p.metrics.snapshot
+                )
         # Grouped-pump registration (ISSUE 8): vector-path processes
         # accept whole VAL runs through on_messages — one handler call
         # per destination per run instead of one per message. Not under
@@ -261,6 +282,7 @@ class Simulation:
                 mcfg,
                 clock=clock if clock is not None else _time.monotonic,
                 metrics=p.metrics,
+                log=p.log,
             )
             for p in self.processes
         ]
@@ -349,7 +371,8 @@ class Simulation:
             while True:
                 t0 = time.perf_counter()
                 got = pump(max_messages - delivered)
-                pump_wall += time.perf_counter() - t0
+                cycle_host = time.perf_counter() - t0
+                pump_wall += cycle_host
                 if coalesce:
                     batches = [p.take_verify_batch() for p in self.processes]
                     if any(batches):
@@ -413,6 +436,12 @@ class Simulation:
                                     for m in ms
                                 ]
                             verify_s = t.seconds
+                        if self.log.enabled:
+                            self.log.event(
+                                "phase_verify",
+                                dur_s=verify_s,
+                                batch=len(flat),
+                            )
                         mask = [umask[j] for j in inv] if inv else umask
                         # Attribute the merged dispatch time size-
                         # proportionally and skip empty batches — charging
@@ -505,7 +534,14 @@ class Simulation:
                 t0 = time.perf_counter()
                 for p in self.processes:
                     p.step()
-                pump_wall += time.perf_counter() - t0
+                step_wall = time.perf_counter() - t0
+                pump_wall += step_wall
+                cycle_host += step_wall
+                if self.log.enabled:
+                    # per-cycle host-pump phase span (delivery + steps)
+                    self.log.event(
+                        "phase_pump", dur_s=cycle_host, msgs=got
+                    )
                 if got == 0 or delivered + got >= max_messages:
                     delivered += got
                     break
@@ -586,7 +622,7 @@ class Simulation:
         running; returns the monitor."""
         from dag_rider_tpu.consensus.invariants import InvariantMonitor
 
-        mon = InvariantMonitor(self.cfg.n, exclude=exclude)
+        mon = InvariantMonitor(self.cfg.n, exclude=exclude, log=self.log)
         for p in self.processes:
             if p.index in mon.exclude:
                 continue

@@ -30,11 +30,13 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, NamedTuple, Optional
 
-from dag_rider_tpu.config import MempoolConfig
+from dag_rider_tpu.config import MempoolConfig, env_float
 from dag_rider_tpu.core.types import Block
 from dag_rider_tpu.mempool.admission import AdmissionController
 from dag_rider_tpu.mempool.batcher import BlockBatcher
 from dag_rider_tpu.mempool.pool import TransactionPool
+from dag_rider_tpu.obs import block_key, sample_tx, tx_key
+from dag_rider_tpu.utils.slog import NOOP, EventLog
 
 __all__ = [
     "Mempool",
@@ -70,9 +72,20 @@ class Mempool:
         *,
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
+        log: Optional[EventLog] = None,
+        trace_sample: Optional[float] = None,
     ) -> None:
         self.cfg = cfg if cfg is not None else MempoolConfig.from_env()
         self.clock = clock
+        #: round-16 obs seam: admission decisions + sampled tx lifecycle
+        #: stamps (tx_submit / tx_batch) ride the structured event log
+        self.log = log if log is not None else NOOP
+        self.trace_sample = (
+            env_float("DAGRIDER_TRACE_SAMPLE")
+            if trace_sample is None
+            else trace_sample
+        )
+        self._trace_state = "accept"
         #: optional utils.metrics.Metrics — submit→a_deliver samples are
         #: forwarded to its histogram so they ride the node's snapshot
         self.metrics = metrics
@@ -105,6 +118,7 @@ class Mempool:
         backpressure signal ("throttle"/"shed" → the caller should slow
         down)."""
         accepted = deduped = shed = 0
+        trace = self.log.enabled
         with self._lock:
             t = self.clock() if now is None else now
             self.pool.expire(t)  # age out before measuring fill
@@ -122,11 +136,29 @@ class Mempool:
                 if verdict == "ok":
                     accepted += 1
                     self._note_inflight(tx, t)
+                    if trace and sample_tx(tx, self.trace_sample):
+                        self.log.event(
+                            "tx_submit", tx=tx_key(tx), client=client
+                        )
                 elif verdict == "dup":
                     deduped += 1
                 else:  # "full": admission raced the hard wall
                     shed += 1
-            return SubmitResult(accepted, deduped, shed, self.admission.state)
+            state = self.admission.state
+            if trace:
+                if state != self._trace_state:
+                    self.log.event(
+                        "mempool_state",
+                        state=state,
+                        prev=self._trace_state,
+                        fill=round(self.pool.fill, 4),
+                    )
+                if shed:
+                    self.log.event(
+                        "mempool_shed", shed=shed, client=client, state=state
+                    )
+            self._trace_state = state
+            return SubmitResult(accepted, deduped, shed, state)
 
     def _note_inflight(self, tx: bytes, t: float) -> None:
         if len(self._inflight) >= self._inflight_cap:
@@ -163,7 +195,19 @@ class Mempool:
                 limit = max(0, self.cfg.max_staged_blocks - staged)
                 if limit == 0:
                     return []
-            return self.batcher.drain(t, force=force, limit=limit)
+            blocks = self.batcher.drain(t, force=force, limit=limit)
+            if blocks and self.log.enabled:
+                for b in blocks:
+                    keys = [
+                        tx_key(tx)
+                        for tx in b.transactions
+                        if sample_tx(tx, self.trace_sample)
+                    ]
+                    if keys:
+                        bk = block_key(b.encode())
+                        for k in keys:
+                            self.log.event("tx_batch", tx=k, block=bk)
+            return blocks
 
     def observe_delivered(
         self, block: Block, now: Optional[float] = None

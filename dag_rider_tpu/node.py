@@ -210,6 +210,19 @@ class Node:
         if reg.n != n:
             raise ValueError(f"keys are for n={reg.n}, config says n={n}")
 
+        # Causal tracing + flight recorder (ISSUE 13, DAGRIDER_TRACE):
+        # tee the ring recorder and the flight trigger watch into
+        # whatever sink the caller brought (e.g. --verbose's stdlib
+        # bridge), so pump_error / verify_exhausted leave a post-mortem.
+        from dag_rider_tpu import obs
+
+        self.tracing = None
+        if obs.trace_enabled():
+            self.tracing = obs.build_tracing(
+                base_sink=log.sink if log is not None else None,
+                context={"node": index},
+            )
+            log = self.tracing.log
         self.log = log if log is not None else NOOP
         peers: Dict[int, str] = {int(k): v for k, v in cfg.get("peers", {}).items()}
         # Lazy: transport/net.py imports grpc at module scope, and grpcio
@@ -247,6 +260,7 @@ class Node:
             snapshot_freshness_s=(
                 None if snap_fresh is None else float(snap_fresh)
             ),
+            log=self.log,
         )
         transport = self.net
         if cfg.get("rbc", True):
@@ -282,7 +296,7 @@ class Node:
             from dag_rider_tpu.verifier.resilient import ResilientVerifier
 
             return ResilientVerifier(
-                [primary, CPUVerifier(reg)], retries=retry
+                [primary, CPUVerifier(reg)], retries=retry, log=self.log
             )
 
         if kind in ("device", "sharded"):
@@ -323,6 +337,7 @@ class Node:
                 base,
                 depth=int(depth) if depth else None,
                 warmup=bool(cfg.get("verify_warmup", True)),
+                log=self.log,
             )
             if fallback:
                 # ladder wiring also hands the pipeline's quarantined
@@ -426,8 +441,13 @@ class Node:
                     mp_cfg if isinstance(mp_cfg, dict) else None
                 ),
                 metrics=self.process.metrics,
+                log=self.process.log,
             )
         self.net.attach_metrics(self.process.metrics)
+        if self.tracing is not None:
+            self.tracing.flight.add_metrics_source(
+                str(index), self.process.metrics.snapshot
+            )
         self.ckpt_dir = cfg.get("checkpoint_dir")
         self.ckpt_every = float(cfg.get("checkpoint_every_s", 30))
         #: per-peer state-transfer fetch deadline — short, because the

@@ -298,7 +298,13 @@ class GrpcTransport(Transport):
         snapshot_min_interval_s: float = 1.0,
         snapshot_freshness_s: Optional[float] = 300.0,
         wall_clock: Callable[[], float] = time.time,
+        log=None,
     ):
+        from dag_rider_tpu.utils.slog import NOOP
+
+        #: obs seam (round 16): peer up/down transitions emit typed
+        #: events alongside the net_peer_* counters
+        self.log = log if log is not None else NOOP
         self.index = index
         #: injectable wall clock for snapshot-request timestamps (the
         #: donor-side freshness gate compares against the same clock)
@@ -500,6 +506,7 @@ class GrpcTransport(Transport):
                 self._consec_fail[peer] = 0
             if was_down:
                 self._inc("net_peer_recovered")
+                self.log.event("net_peer_recovered", peer=peer)
             return
         self._on_failure(peer, payload, attempt)
 
@@ -517,6 +524,11 @@ class GrpcTransport(Transport):
                 just_down = self._consec_fail[peer] == self.down_after
             if just_down:
                 self._inc("net_peer_down")
+                self.log.event(
+                    "net_peer_down",
+                    peer=peer,
+                    consecutive=self.down_after,
+                )
             return
         with self._lock:
             self.metrics.inc("net_send_errors")

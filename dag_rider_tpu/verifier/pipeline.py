@@ -38,6 +38,7 @@ from typing import Callable, Deque, List, Optional, Sequence
 
 from dag_rider_tpu import config
 from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.utils.slog import NOOP, EventLog
 from dag_rider_tpu.verifier.base import Verifier
 
 
@@ -68,7 +69,9 @@ class VerifierPipeline(Verifier):
         *,
         fixed_bucket: Optional[int] = None,
         warmup: bool = True,
+        log: EventLog = NOOP,
     ):
+        self.log = log
         if not callable(getattr(verifier, "dispatch_batch", None)) or not (
             callable(getattr(verifier, "resolve_batch", None))
         ):
@@ -202,6 +205,7 @@ class VerifierPipeline(Verifier):
         fresh serial pass on the wrapped verifier. A second failure
         rejects the chunk — fail closed, never fail open."""
         self.quarantined += 1
+        self.log.event("verify_quarantined", chunk=len(chunk))
         vs = list(chunk)
         try:
             if self.quarantine_verifier is not None:
@@ -222,6 +226,9 @@ class VerifierPipeline(Verifier):
         was the oldest, already popped) and False for a dispatch fault
         (the failed chunk never entered the window)."""
         self.poisoned_windows += 1
+        self.log.event(
+            "verify_window_poisoned", inflight=len(self._inflight)
+        )
         entries = []  # (mask-or-None, chunk) in FIFO order
         while self._inflight:
             h, ch = self._inflight.popleft()

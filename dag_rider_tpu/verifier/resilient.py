@@ -47,6 +47,7 @@ from typing import List, Optional, Sequence
 
 from dag_rider_tpu import config
 from dag_rider_tpu.core.types import Vertex
+from dag_rider_tpu.utils.slog import NOOP, EventLog
 from dag_rider_tpu.verifier.base import Verifier
 
 
@@ -90,10 +91,15 @@ class ResilientVerifier(Verifier):
         jitter: float = 0.5,
         seed: int = 0,
         probe_interval_s: float = 0.5,
+        log: EventLog = NOOP,
     ):
         if not tiers:
             raise ValueError("ResilientVerifier needs at least one tier")
         self.tiers = list(tiers)
+        #: obs seam (round 16): tier transitions that previously only
+        #: bumped gauges now emit typed events; verify_exhausted is a
+        #: flight-recorder trigger
+        self.log = log
         self.retries = (
             default_verify_retry() if retries is None else max(0, int(retries))
         )
@@ -133,6 +139,7 @@ class ResilientVerifier(Verifier):
             return [not d for d in self._down]
 
     def _mark_down(self, idx: int) -> None:
+        self.log.event("verify_tier_down", tier=idx)
         with self._lock:
             self._down[idx] = True
             if idx in self._probing:
@@ -174,6 +181,7 @@ class ResilientVerifier(Verifier):
                 with self._lock:
                     self._down[idx] = False
                     self._probing.discard(idx)
+                self.log.event("verify_tier_recovered", tier=idx)
                 return
 
     # -- ladder mechanics -------------------------------------------------
@@ -198,6 +206,12 @@ class ResilientVerifier(Verifier):
                     last_exc = e
                     if attempt < self.retries:
                         self.retries_total += 1
+                        self.log.event(
+                            "verify_retry",
+                            tier=idx,
+                            attempt=attempt + 1,
+                            error=repr(e)[:200],
+                        )
                         time.sleep(
                             delay
                             * (1.0 + self._jitter * self._rng.random())
@@ -207,12 +221,20 @@ class ResilientVerifier(Verifier):
                     self.last_tier = idx
                     if pos > 0:
                         self.fallbacks_total += 1
+                        self.log.event(
+                            "verify_fallback", tier=idx, from_tier=order[0]
+                        )
                     return out
             self._mark_down(idx)
         # the whole ladder failed: fail closed (attempt semantics were
         # preserved throughout — nothing was admitted along the way)
         self.exhausted_total += 1
         self.last_tier = len(self.tiers)
+        self.log.event(
+            "verify_exhausted",
+            tiers=len(self.tiers),
+            error=repr(last_exc)[:200] if last_exc is not None else None,
+        )
         del last_exc
         return reject
 

@@ -1,0 +1,367 @@
+"""Causal tracing + flight recorder (round 16 tentpole).
+
+Covers the whole obs surface: bounded ring, zero cost when off,
+deterministic payload-hash sampling, injectable clock, lifecycle-chain
+decomposition whose components sum to the measured total, chrome
+export, flight dumps on planted triggers (including a real invariant
+violation under transport chaos and the scenario-runner post-hoc path),
+trace-on/off commit-order byte identity across committee sizes and
+fault seeds, and the driderlint events checker shown non-vacuous by a
+planted unregistered event.
+"""
+
+import ast
+import json
+import os
+
+import pytest
+
+from dag_rider_tpu import obs
+from dag_rider_tpu.analysis import events as events_checker
+from dag_rider_tpu.config import Config, MempoolConfig
+from dag_rider_tpu.consensus.invariants import (
+    InvariantMonitor,
+    InvariantViolation,
+)
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core.types import Block, Vertex, VertexID
+from dag_rider_tpu.obs import export, report
+from dag_rider_tpu.obs.flight import FlightRecorder
+from dag_rider_tpu.obs.recorder import TraceRecorder
+from dag_rider_tpu.transport.faults import FaultPlan, FaultyTransport
+from dag_rider_tpu.utils.slog import KNOWN_EVENTS, NOOP, EventLog
+
+
+# -- ring recorder -----------------------------------------------------------
+
+
+def test_ring_stays_bounded_over_long_run():
+    rec = TraceRecorder(capacity=256)
+    log = EventLog(rec, clock=lambda: 0.0)
+    for i in range(10_000):
+        log.event("admit", round=i)
+    assert len(rec) == 256
+    assert rec.dropped == 10_000 - 256
+    evs = rec.events()
+    assert evs[-1]["round"] == 9_999  # newest retained, oldest evicted
+    rec.clear()
+    assert len(rec) == 0
+
+
+def test_ring_bounded_under_traced_simulation():
+    tracing = obs.build_tracing(ring=128, flight_dir="")
+    sim = Simulation(
+        Config(n=4, coin="round_robin", propose_empty=True), log=tracing.log
+    )
+    sim.submit_blocks(per_process=2)
+    for _ in range(60):
+        sim.run(max_messages=4 * 3)
+    assert len(tracing.recorder) <= 128
+    assert tracing.recorder.dropped > 0  # the run genuinely overflowed
+
+
+# -- zero cost when off ------------------------------------------------------
+
+
+def test_trace_off_is_a_single_attribute_test(monkeypatch):
+    monkeypatch.delenv("DAGRIDER_TRACE", raising=False)
+    sim = Simulation(Config(n=4, coin="round_robin"))
+    assert sim.recorder is None and sim.flight is None
+    assert sim.log is NOOP and not sim.log.enabled
+    assert all(not p.log.enabled for p in sim.processes)
+    NOOP.event("tx_submit", tx=1)  # no sink: returns before any work
+
+
+def test_trace_knob_autowires_simulation(monkeypatch, tmp_path):
+    monkeypatch.setenv("DAGRIDER_TRACE", "1")
+    monkeypatch.setenv("DAGRIDER_FLIGHT_DIR", str(tmp_path))
+    sim = Simulation(Config(n=4, coin="round_robin", propose_empty=True))
+    assert sim.recorder is not None and sim.flight is not None
+    sim.submit_blocks(per_process=1)
+    sim.run(max_messages=2_000)
+    names = {r["event"] for r in sim.recorder.events()}
+    assert "phase_pump" in names and "tx_propose" in names
+
+
+# -- sampling + clock --------------------------------------------------------
+
+
+def test_sampling_is_deterministic_and_edge_exact():
+    txs = [f"tx-{i}".encode() for i in range(400)]
+    assert all(obs.sample_tx(t, 1.0) for t in txs)
+    assert not any(obs.sample_tx(t, 0.0) for t in txs)
+    first = [obs.sample_tx(t, 0.25) for t in txs]
+    assert first == [obs.sample_tx(t, 0.25) for t in txs]
+    frac = sum(first) / len(first)
+    assert 0.1 < frac < 0.4  # crc32 spreads ~uniformly
+
+
+def test_injected_clock_stamps_events():
+    t = [100.0]
+    tracing = obs.build_tracing(clock=lambda: t[0], flight_dir="")
+    tracing.log.event("wave_decided", round=1)
+    t[0] = 250.0
+    tracing.log.event("wave_decided", round=2)
+    ts = [r["ts"] for r in tracing.recorder.events()]
+    assert ts == [100.0, 250.0]
+
+
+# -- lifecycle chains + decomposition ---------------------------------------
+
+
+def _traced_loaded_sim(seconds=0.6):
+    from dag_rider_tpu.mempool.loadgen import ClusterLoadDriver, LoadGenerator
+
+    tracing = obs.build_tracing(sample_rate=1.0, flight_dir="")
+    sim = Simulation(
+        Config(
+            n=4,
+            coin="round_robin",
+            propose_empty=True,
+            sync_request_cooldown_s=0.0,
+            sync_serve_cooldown_s=0.0,
+        ),
+        log=tracing.log,
+    )
+    gen = LoadGenerator(clients=4, rate=300.0, tx_bytes=32, seed=3)
+    drv = ClusterLoadDriver(
+        sim, gen, mcfg=MempoolConfig(cap=4096, batch_bytes=512)
+    )
+    drv.run(seconds)
+    return tracing, sim
+
+
+def test_lifecycle_chain_components_sum_to_total():
+    tracing, _sim = _traced_loaded_sim()
+    events = tracing.recorder.events()
+    chains = report.chains(events)
+    rep = report.decompose(events)
+    assert chains, "traced load produced no complete submit->deliver chains"
+    assert rep["txs"] == len(chains)
+    for c in chains:
+        parts = (
+            c["mempool_queue_s"] + c["propose_stage_s"] + c["wave_commit_s"]
+        )
+        assert parts == pytest.approx(c["total_s"], rel=1e-9, abs=1e-9)
+    # the acceptance gate: the per-phase breakdown at p50 sums within
+    # 10% of the measured submit->deliver p50
+    p50 = rep["percentiles"]["p50"]
+    parts = (
+        p50["mempool_queue_s"]
+        + p50["propose_stage_s"]
+        + p50["wave_host_pump_s"]
+        + p50["wave_verify_s"]
+        + p50["wave_cert_s"]
+        + p50["wave_transport_wait_s"]
+    )
+    assert parts == pytest.approx(p50["total_s"], rel=0.10)
+    occ = rep["phase_occupancy"]
+    assert occ["pump_s"] > 0.0 and occ["wall_s"] > 0.0
+
+
+def test_chrome_export_roundtrips(tmp_path):
+    tracing, _sim = _traced_loaded_sim(seconds=0.3)
+    evs = tracing.recorder.events()
+    path = str(tmp_path / "trace.json")
+    export.write_chrome_trace(evs, path)
+    doc = json.load(open(path))
+    assert len(doc["traceEvents"]) == len(evs)
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert phases <= {"X", "i"}
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert spans and all(e["dur"] >= 0 and e["ts"] >= 0 for e in spans)
+    # load_events re-flattens a chrome trace into joinable records
+    back = export.load_events(path)
+    assert len(back) == len(evs)
+    # and a raw ring dump loads identically
+    raw = str(tmp_path / "ring.json")
+    tracing.recorder.write_json(raw)
+    assert len(export.load_events(raw)) == len(evs)
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_dump_on_trigger_and_budget(tmp_path):
+    fr = FlightRecorder(str(tmp_path), capacity=8, clock=lambda: 7.0)
+    fr.add_metrics_source("p0", lambda: {"counters": {"admitted": 3}})
+    log = EventLog(fr.sink, clock=lambda: 7.0)
+    for i in range(20):
+        log.event("admit", round=i)
+    assert fr.dumps == []  # no trigger yet
+    log.event("invariant_violation", kind="planted", detail="x")
+    assert len(fr.dumps) == 1
+    dump = export.load_flight(fr.dumps[0])
+    assert dump is not None and dump["reason"] == "invariant_violation"
+    assert dump["trigger"]["kind"] == "planted"
+    assert len(dump["events"]) <= 8 + 1
+    assert dump["metrics"]["p0"]["counters"]["admitted"] == 3
+    # dump budget: a crash loop cannot fill the disk
+    for _ in range(50):
+        log.event("pump_error", error="boom")
+    assert len(fr.dumps) <= 8
+
+
+def test_flight_dump_left_by_violation_under_chaos(tmp_path):
+    """A real InvariantViolation raised mid-pump under transport chaos
+    leaves a loadable post-mortem dump even though the exception unwinds
+    straight out of the delivery callback."""
+    tracing = obs.build_tracing(flight_dir=str(tmp_path), flight_events=64)
+    tp = FaultyTransport(FaultPlan(duplicate=0.05, seed=2))
+    sim = Simulation(
+        Config(n=4, coin="round_robin", propose_empty=True),
+        transport=tp,
+        log=tracing.log,
+    )
+    monitor = sim.attach_invariant_monitor()
+    for p in sim.processes:  # externally-built tracing: wire sources
+        tracing.flight.add_metrics_source(str(p.index), p.metrics.snapshot)
+    sim.submit_blocks(per_process=2)
+    for _ in range(10):
+        sim.run(max_messages=200)
+    # plant the violation: replay an already-observed slot at view 0
+    assert monitor.observed > 0
+    v = Vertex(
+        id=VertexID(1, 0), block=Block((b"tx",)), strong_edges=()
+    )
+    monitor._seen_slots.setdefault(0, set()).add((1, 0))
+    with pytest.raises(InvariantViolation, match="twice"):
+        monitor.observe(0, v)
+    assert len(tracing.flight.dumps) == 1
+    dump = export.load_flight(tracing.flight.dumps[0])
+    assert dump["trigger"]["kind"] == "double_delivery"
+    assert any(r["event"] == "phase_pump" for r in dump["events"])
+    assert dump["metrics"]  # per-process snapshots rode along
+
+
+def test_scenario_posthoc_violation_dumps_flight(monkeypatch, tmp_path):
+    """The scenario runner's post-hoc audits route through the flight
+    recorder: an impossible liveness floor must raise AND leave a dump."""
+    from dag_rider_tpu.consensus.scenarios import Scenario, run_scenario
+
+    monkeypatch.setenv("DAGRIDER_TRACE", "1")
+    monkeypatch.setenv("DAGRIDER_FLIGHT_DIR", str(tmp_path))
+    sc = Scenario(n=4, cycles=8, min_waves=999)
+    with pytest.raises(InvariantViolation):
+        run_scenario(sc)
+    dumps = sorted(tmp_path.glob("flight_*.json"))
+    assert dumps, "post-hoc violation left no flight dump"
+    dump = export.load_flight(str(dumps[0]))
+    assert dump["trigger"]["view"] == "posthoc"
+
+
+def test_scenario_report_carries_flight_dumps(monkeypatch, tmp_path):
+    from dag_rider_tpu.consensus.scenarios import Scenario, run_scenario
+
+    monkeypatch.setenv("DAGRIDER_TRACE", "1")
+    monkeypatch.setenv("DAGRIDER_FLIGHT_DIR", str(tmp_path))
+    rep = run_scenario(Scenario(n=4, cycles=24))
+    assert rep["flight_dumps"] == []  # clean run: no triggers fired
+
+
+# -- trace on/off byte identity ----------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n,seed", [(4, 0), (4, 1), (16, 0), (16, 1), (32, 0), (32, 1)]
+)
+def test_trace_onoff_commit_order_byte_identical(n, seed):
+    """Tracing observes — it must never perturb the protocol. Same
+    committee, same fault seed, identical pump schedule: the delivery
+    sequences (id + digest, every view) must match byte for byte."""
+    orders = {}
+    for path in ("off", "on"):
+        tracing = (
+            obs.build_tracing(sample_rate=1.0, flight_dir="")
+            if path == "on"
+            else None
+        )
+        tp = FaultyTransport(FaultPlan(duplicate=0.05, seed=seed))
+        sim = Simulation(
+            Config(n=n, coin="round_robin", propose_empty=True),
+            transport=tp,
+            log=tracing.log if tracing is not None else None,
+        )
+        sim.submit_blocks(per_process=2)
+        for _ in range(12):  # fixed schedule: both sides do the same work
+            sim.run(max_messages=2 * n * n)
+        orders[path] = [
+            [(v.id, v.digest()) for v in d] for d in sim.deliveries
+        ]
+    assert any(orders["off"]), "no deliveries — the A/B was vacuous"
+    assert orders["off"] == orders["on"]
+
+
+# -- events checker (driderlint) --------------------------------------------
+
+
+def _synthetic(src):
+    return [("dag_rider_tpu/fake.py", ast.parse(src), src)]
+
+
+def test_events_checker_catches_planted_unregistered_event():
+    src = 'log.event("definitely_not_registered", x=1)\n'
+    findings = events_checker.run(_synthetic(src), "/nonexistent")
+    assert len(findings) == 1
+    assert "definitely_not_registered" in findings[0].message
+    assert findings[0].checker == "events"
+
+
+def test_events_checker_accepts_registered_and_dynamic_names():
+    src = (
+        'log.event("tx_submit", tx=1)\n'
+        "log.event(name, x=1)\n"  # non-literal: out of scope
+        'other.event_like("nope")\n'
+    )
+    assert events_checker.run(_synthetic(src), "/nonexistent") == []
+
+
+def test_events_checker_wired_into_run_static():
+    from dag_rider_tpu.analysis.core import run_static
+
+    src = 'log.event("typo_event_name")\n'
+    kept, _sup, _unused = run_static("/root/repo", files=_synthetic(src))
+    assert any(
+        f.checker == "events" and "typo_event_name" in f.message
+        for f in kept
+    )
+
+
+def test_every_emitted_event_name_is_registered():
+    # the live-tree guarantee the checker enforces, asserted directly
+    from dag_rider_tpu.analysis.core import discover
+
+    findings = events_checker.run(discover("/root/repo"), "/root/repo")
+    assert findings == []
+    assert "tx_deliver" in KNOWN_EVENTS  # the join the report depends on
+
+
+# -- obs_report CLI ----------------------------------------------------------
+
+
+def test_obs_report_cli_report_and_flight_modes(tmp_path, capsys):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+
+    tracing, _sim = _traced_loaded_sim(seconds=0.3)
+    ring = str(tmp_path / "ring.json")
+    tracing.recorder.write_json(ring)
+    assert obs_report.main(["report", ring]) == 0
+    out = capsys.readouterr().out
+    assert "p50" in out and "wave_commit" in out
+
+    chrome = str(tmp_path / "chrome.json")
+    assert obs_report.main(["chrome", ring, chrome]) == 0
+    assert json.load(open(chrome))["traceEvents"]
+
+    fr = FlightRecorder(str(tmp_path), capacity=8, clock=lambda: 1.0)
+    log = EventLog(fr.sink, clock=lambda: 1.0)
+    log.event("pump_error", error="planted")
+    assert obs_report.main(["flight", fr.dumps[0]]) == 0
+    out = capsys.readouterr().out
+    assert "pump_error" in out
