@@ -18,7 +18,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
-from typing import List, Sequence, Set, Tuple
+from typing import List, Optional, Sequence, Set, Tuple
 
 #: (relpath-with-forward-slashes, parsed tree, source text)
 SourceFile = Tuple[str, ast.Module, str]
@@ -106,7 +106,7 @@ def apply_allowlist(
 
 
 def run_static(
-    repo_root: str, files: Sequence[SourceFile] = None
+    repo_root: str, files: Optional[Sequence[SourceFile]] = None
 ) -> Tuple[List[Finding], List[Finding], List[Allow]]:
     """Run every static checker over the tree and apply the allowlist.
 
@@ -117,10 +117,15 @@ def run_static(
         allowlist,
         determinism,
         events,
+        flow,
         jitpure,
         knobs,
+        ladder,
+        locks,
         metricsreg,
         oracle,
+        release,
+        shapes,
     )
 
     if files is None:
@@ -128,6 +133,11 @@ def run_static(
     findings: List[Finding] = []
     for checker in (knobs, determinism, oracle, jitpure, metricsreg, events):
         findings.extend(checker.run(files, repo_root))
+    # v2 interprocedural checkers share ONE flow-graph build (the graph
+    # is the expensive half of their runtime)
+    graph = flow.build(files)
+    for checker in (locks, release, shapes, ladder):
+        findings.extend(checker.run(files, repo_root, graph=graph))
     bad_allows = [a for a in allowlist.ALLOWS if not a.reason.strip()]
     kept, suppressed, unused = apply_allowlist(findings, allowlist.ALLOWS)
     for a in bad_allows:

@@ -37,7 +37,7 @@ from __future__ import annotations
 import sys
 import threading
 from collections import deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "RaceViolation",
@@ -245,13 +245,23 @@ def _caller_module(depth: int = 2) -> Tuple[str, int]:
     return f.f_globals.get("__name__", ""), f.f_lineno
 
 
+#: every site the factories handed a tracked lock for, across the whole
+#: install() window — the dynamic half of the static/dynamic lock-site
+#: cross-validation (tests/test_analysis_v2.py asserts these are a
+#: subset of locks.lock_sites()). Never cleared by uninstall(): the
+#: test wants the union over every suite that ran under DAGRIDER_RACE.
+SITES: Set[str] = set()
+
+
 def _tracked_lock_factory():
     mod, line = _caller_module()
     if not mod.startswith("dag_rider_tpu") or mod.startswith(
         "dag_rider_tpu.analysis"
     ):
         return _real_lock()
-    return TrackedLock(_graph, f"{mod}:{line}")
+    site = f"{mod}:{line}"
+    SITES.add(site)
+    return TrackedLock(_graph, site)
 
 
 def _tracked_rlock_factory():
@@ -260,7 +270,9 @@ def _tracked_rlock_factory():
         "dag_rider_tpu.analysis"
     ):
         return _real_rlock()
-    return TrackedRLock(_graph, f"{mod}:{line}")
+    site = f"{mod}:{line}"
+    SITES.add(site)
+    return TrackedRLock(_graph, site)
 
 
 # -- guarded fields ---------------------------------------------------------

@@ -1,0 +1,391 @@
+"""jit shape-stability: recompile hazards in traced kernels.
+
+The device hot path stays fast only while every jitted program in
+``ops/`` + ``parallel/`` compiles once per bucket shape and never
+falls back to the interpreter. Three hazard classes defeat that
+silently — the code still returns right answers, just recompiled or
+synced per call:
+
+* **Traced-value Python control flow.** ``if``/``while``/``assert``
+  on a value derived from a traced argument either raises
+  ``TracerBoolConversionError`` at trace time or, with
+  ``static_argnums`` misuse, silently keys a retrace per value. The
+  decision belongs in ``lax.cond``/``jnp.where``/``lax.while_loop``.
+* **Host round-trips.** ``.item()``/``.tolist()``/``int()``/
+  ``float()``/``np.asarray()`` on a tracer forces a device sync per
+  call inside the traced region (or fails to trace at all).
+* **Unhashable static args.** A ``static_argnames`` parameter keys
+  the jit cache by value; passing a ``list``/``dict``/``set`` display
+  at a call site is a ``TypeError`` the first time that path runs.
+
+The checker is a one-pass abstract interpreter over each jitted
+body with a three-point taint lattice ``TRACED > SHAPE > STATIC``:
+parameters start TRACED (static ones STATIC), ``x.shape``/``len(x)``
+of a TRACED value is SHAPE (trace-time constant — branching on it is
+the *intended* bucketing idiom and is not flagged; a ``while`` on it
+is flagged, because shape-driven iteration counts unroll a different
+program per shape class). Everything else propagates the max of its
+inputs. ``is``/``is not`` comparisons and ``isinstance`` stay STATIC
+(trace-time identity on optionals is standard jit idiom).
+
+jit spellings recognized are jitpure's: ``@jax.jit``, ``@jit``,
+``@functools.partial(jax.jit, ...)``, and ``name = jax.jit(fn)``
+rebinding. Static-arg call-site checks resolve through the
+interprocedural flow graph, so a bad call in ``verifier/`` against a
+kernel in ``ops/`` is still caught.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dag_rider_tpu.analysis import flow
+from dag_rider_tpu.analysis.core import Finding, SourceFile
+from dag_rider_tpu.analysis.jitpure import _is_jit_expr
+
+CHECKER = "shapes"
+
+_SCOPES = ("dag_rider_tpu/ops/", "dag_rider_tpu/parallel/")
+
+STATIC, SHAPE, TRACED = 0, 1, 2
+
+#: attribute reads that turn a tracer into a trace-time constant
+_SHAPE_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+#: calls that force a host round-trip when fed a tracer
+_SYNC_CALLS = frozenset({"int", "float", "bool", "complex"})
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+_SYNC_NP = frozenset({"np.asarray", "np.array", "numpy.asarray", "numpy.array"})
+
+_UNHASHABLE_DISPLAYS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                        ast.DictComp, ast.SetComp)
+
+
+@dataclasses.dataclass
+class _JitFn:
+    fi: flow.FuncInfo
+    static_names: Set[str]
+
+
+def _static_params(fn: ast.AST, jit_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names keyed statically, from static_argnames/nums."""
+    names: Set[str] = set()
+    params = flow.param_names(fn)
+    if jit_call is None:
+        return names
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    names.add(sub.value)
+        elif kw.arg == "static_argnums":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, int
+                ):
+                    if 0 <= sub.value < len(params):
+                        names.add(params[sub.value])
+    return names
+
+
+def _jit_call_of(expr: ast.AST) -> Optional[ast.Call]:
+    """The Call node carrying static_arg* keywords, if any."""
+    if isinstance(expr, ast.Call):
+        f = flow.dotted(expr.func)
+        if f in ("functools.partial", "partial") and expr.args:
+            return expr if _is_jit_expr(expr.args[0]) else None
+        if _is_jit_expr(expr.func):
+            return expr
+    return None
+
+
+def _jitted_in_module(
+    rel: str, tree: ast.Module, graph: flow.FlowGraph
+) -> List[_JitFn]:
+    mod_name = flow.module_name(rel)
+    out: List[_JitFn] = []
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    claimed: Dict[str, Optional[ast.Call]] = {}
+    for name, fn in defs.items():
+        for dec in fn.decorator_list:
+            if _is_jit_expr(dec):
+                claimed[name] = _jit_call_of(dec)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_expr(node.value.func) and node.value.args:
+                arg = node.value.args[0]
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    claimed.setdefault(arg.id, _jit_call_of(node.value))
+    for name, jc in claimed.items():
+        fn = defs[name]
+        qn = f"{mod_name}.{name}"
+        fi = graph.functions.get(qn) or flow.FuncInfo(
+            qn, rel, mod_name, None, name, fn, fn.lineno
+        )
+        out.append(_JitFn(fi, _static_params(fn, jc)))
+    return out
+
+
+class _Interp:
+    """One jitted body; findings accumulate in self.out."""
+
+    def __init__(self, rel: str, fname: str, out: List[Finding]):
+        self.rel = rel
+        self.fname = fname
+        self.out = out
+
+    def flag(self, node: ast.AST, msg: str) -> None:
+        self.out.append(
+            Finding(
+                CHECKER, self.rel, node.lineno, f"{msg} in jitted "
+                f"{self.fname}()"
+            )
+        )
+
+    # -- expression taint --------------------------------------------------
+    def taint(self, node: ast.AST, env: Dict[str, int]) -> int:
+        if node is None or isinstance(node, ast.Constant):
+            return STATIC
+        if isinstance(node, ast.Name):
+            return env.get(node.id, STATIC)
+        if isinstance(node, ast.Attribute):
+            base = self.taint(node.value, env)
+            if node.attr in _SHAPE_ATTRS and base == TRACED:
+                return SHAPE
+            return base
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, env)
+        if isinstance(node, ast.Compare):
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return STATIC
+            ts = [self.taint(node.left, env)] + [
+                self.taint(c, env) for c in node.comparators
+            ]
+            return max(ts)
+        if isinstance(node, ast.IfExp):
+            t = self.taint(node.test, env)
+            if t == TRACED:
+                self.flag(
+                    node,
+                    "Python conditional expression on a traced value "
+                    "(use jnp.where)",
+                )
+            return max(
+                self.taint(node.body, env), self.taint(node.orelse, env)
+            )
+        if isinstance(node, (ast.Lambda,)):
+            return STATIC
+        kids = [
+            self.taint(c, env)
+            for c in ast.iter_child_nodes(node)
+            if not isinstance(c, (ast.operator, ast.cmpop, ast.boolop,
+                                  ast.unaryop, ast.expr_context))
+        ]
+        return max(kids, default=STATIC)
+
+    def _call_taint(self, node: ast.Call, env: Dict[str, int]) -> int:
+        d = flow.dotted(node.func)
+        args = max(
+            [self.taint(a, env) for a in node.args]
+            + [self.taint(kw.value, env) for kw in node.keywords],
+            default=STATIC,
+        )
+        if d in ("isinstance", "getattr", "hasattr", "callable", "type"):
+            return STATIC
+        if d == "len":
+            return SHAPE if args == TRACED else args
+        if d in _SYNC_CALLS and args == TRACED:
+            self.flag(
+                node,
+                f"{d}() on a traced value — host round-trip / "
+                "TracerConversion",
+            )
+            return STATIC
+        if d in _SYNC_NP and args == TRACED:
+            self.flag(
+                node, f"{d}() on a traced value — host materialization"
+            )
+            return STATIC
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _SYNC_METHODS:
+                if self.taint(node.func.value, env) == TRACED:
+                    self.flag(
+                        node,
+                        f".{node.func.attr}() on a traced value — device "
+                        "sync per call",
+                    )
+                    return STATIC
+        if d is not None:
+            head = d.partition(".")[0]
+            if head in ("jnp", "jax", "lax"):
+                return TRACED
+        return args
+
+    # -- statement walk ----------------------------------------------------
+    def run_body(self, body: Sequence[ast.stmt], env: Dict[str, int]) -> None:
+        # two passes: loop-carried taint stabilizes on the second
+        for _ in range(2):
+            for stmt in body:
+                self.stmt(stmt, env)
+
+    def _bind(self, tgt: ast.AST, t: int, env: Dict[str, int]) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = t
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind(el, t, env)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, t, env)
+
+    def stmt(self, node: ast.stmt, env: Dict[str, int]) -> None:
+        if isinstance(node, ast.Assign):
+            t = self.taint(node.value, env)
+            for tgt in node.targets:
+                self._bind(tgt, t, env)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._bind(node.target, self.taint(node.value, env), env)
+        elif isinstance(node, ast.AugAssign):
+            t = self.taint(node.value, env)
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = max(
+                    env.get(node.target.id, STATIC), t
+                )
+        elif isinstance(node, (ast.If, ast.While)):
+            t = self.taint(node.test, env)
+            if t == TRACED:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self.flag(
+                    node,
+                    f"Python {kind} on a traced value — trace-time "
+                    "error or per-value retrace (use lax.cond/"
+                    "lax.while_loop)",
+                )
+            elif t == SHAPE and isinstance(node, ast.While):
+                self.flag(
+                    node,
+                    "Python while on a shape-derived bound — one "
+                    "unrolled program per shape class (use "
+                    "lax.fori_loop)",
+                )
+            self.run_body(node.body, env)
+            self.run_body(node.orelse, env)
+        elif isinstance(node, ast.For):
+            t = self.taint(node.iter, env)
+            if t == TRACED:
+                self.flag(
+                    node,
+                    "Python for over a traced value — unrolls per "
+                    "element (use lax.scan/lax.fori_loop)",
+                )
+            self._bind(node.target, t, env)
+            self.run_body(node.body, env)
+            self.run_body(node.orelse, env)
+        elif isinstance(node, ast.Assert):
+            if self.taint(node.test, env) == TRACED:
+                self.flag(
+                    node,
+                    "assert on a traced value — trace-time error "
+                    "(use checkify or a host-side check)",
+                )
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            self.run_body(node.body, env)
+        elif isinstance(node, ast.Try):
+            self.run_body(node.body, env)
+            for h in node.handlers:
+                self.run_body(h.body, env)
+            self.run_body(node.orelse, env)
+            self.run_body(node.finalbody, env)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # scan/cond bodies close over tracers; their own params are
+            # tracers too (carry/element slots)
+            inner = dict(env)
+            for p in flow.param_names(node):
+                inner[p] = TRACED
+            self.run_body(node.body, inner)
+        elif isinstance(node, (ast.Return, ast.Expr)):
+            if node.value is not None:
+                self.taint(node.value, env)
+
+
+def _check_jit_body(jf: _JitFn, out: List[Finding]) -> None:
+    fn = jf.fi.node
+    env: Dict[str, int] = {}
+    for p in flow.param_names(fn):
+        env[p] = STATIC if p in jf.static_names else TRACED
+    interp = _Interp(jf.fi.rel, jf.fi.name, out)
+    interp.run_body(fn.body, env)
+
+
+def _check_static_callsites(
+    jit_fns: Dict[str, _JitFn], graph: flow.FlowGraph, out: List[Finding]
+) -> None:
+    for qn, sites in graph.callsites.items():
+        caller = graph.functions[qn]
+        for cs in sites:
+            jf = jit_fns.get(cs.target)
+            if jf is None or not jf.static_names:
+                continue
+            params = flow.param_names(jf.fi.node)
+            for i, a in enumerate(cs.node.args):
+                name = params[i] if i < len(params) else None
+                if name in jf.static_names and isinstance(
+                    a, _UNHASHABLE_DISPLAYS
+                ):
+                    out.append(
+                        Finding(
+                            CHECKER,
+                            caller.rel,
+                            a.lineno,
+                            f"unhashable static arg {name!r} passed to "
+                            f"{jf.fi.name}() — jit cache key TypeError",
+                        )
+                    )
+            for kw in cs.node.keywords:
+                if kw.arg in jf.static_names and isinstance(
+                    kw.value, _UNHASHABLE_DISPLAYS
+                ):
+                    out.append(
+                        Finding(
+                            CHECKER,
+                            caller.rel,
+                            kw.value.lineno,
+                            f"unhashable static arg {kw.arg!r} passed to "
+                            f"{jf.fi.name}() — jit cache key TypeError",
+                        )
+                    )
+
+
+def run(
+    files: Sequence[SourceFile],
+    repo_root: str,
+    graph: Optional[flow.FlowGraph] = None,
+) -> List[Finding]:
+    if graph is None:
+        graph = flow.build(files)
+    out: List[Finding] = []
+    jit_fns: Dict[str, _JitFn] = {}
+    for rel, tree, _src in files:
+        if not rel.startswith(_SCOPES):
+            continue
+        for jf in _jitted_in_module(rel, tree, graph):
+            jit_fns[jf.fi.qname] = jf
+            _check_jit_body(jf, out)
+    _check_static_callsites(jit_fns, graph, out)
+    # stable order, dedup the two-pass loop artifacts
+    seen: Set[Tuple[str, int, str]] = set()
+    uniq: List[Finding] = []
+    for f in out:
+        key = (f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
