@@ -667,6 +667,297 @@ def _trace_ab_rung(
     return entry
 
 
+def _finality_rung(
+    n: int = 64,
+    wall_s: float = 10.0,
+    rate: float = 2000.0,
+    drain_s: float = 30.0,
+) -> dict:
+    """ladder.finality rung (ISSUE 16): submit→deliver finality with
+    pipelined waves + eager optimistic delivery, in two halves.
+
+    Half 1 — the byte-identity gate: knobs-off vs knobs-on lockstep
+    sims over a seeded n × adversary matrix must produce byte-identical
+    per-view delivery sequences (id + digest), the eager reconciliation
+    books must balance (delivered == reconciled) and the expected-zero
+    rollback counter must read zero on every honest process. RAISES
+    AssertionError on any divergence — a recorded entry IS a passed
+    gate.
+
+    Half 2 — the wall-clock headline: a mempool-fronted load run at
+    ``n`` with everything on (wave pipeline, eager delivery, adaptive
+    batch deadline) against a knobs-off twin. Each transaction's
+    end-to-end latency is decomposed at observation time into
+    queueing (submit → block built, the batcher's hold) and wave lag
+    (block built → a_deliver, DAG admission + commit + flush), so the
+    attribution components sum to the measured total per sample — the
+    means are checked to sum exactly (float slack only). The eager
+    stream's submit→early-surface p50 rides alongside as the optimistic
+    finality number, and ``p50_under_1s`` records the sub-second
+    acceptance gate at the knobs-on side."""
+    import time as _t
+
+    from dag_rider_tpu.config import Config, MempoolConfig
+    from dag_rider_tpu.consensus.adversary import (
+        ByzantineProcess,
+        make_behavior,
+    )
+    from dag_rider_tpu.consensus.process import Process
+    from dag_rider_tpu.consensus.simulator import Simulation
+    from dag_rider_tpu.mempool.loadgen import (
+        ClusterLoadDriver,
+        LoadGenerator,
+    )
+    from dag_rider_tpu.utils.metrics import Histogram
+
+    # -- half 1: identity gate over the seeded matrix ----------------------
+
+    def one_side(sz, seed, adversary, knobs_on, cycles):
+        cfg = Config(
+            n=sz,
+            coin="round_robin",
+            propose_empty=True,
+            wave_pipeline=knobs_on,
+            eager_deliver=knobs_on,
+            # lockstep pump: wall-clock sync throttles would starve the
+            # anti-entropy recovery the withhold adversary forces
+            sync_request_cooldown_s=0.0,
+            sync_serve_cooldown_s=0.0,
+            sync_patience=1,
+        )
+        nbyz = cfg.f if adversary else 0
+        behaviors = {
+            i: make_behavior(adversary, seed=seed + 1000 + i)
+            for i in range(nbyz)
+        }
+
+        def factory(pcfg, i, ptp, **kwargs):
+            if i in behaviors:
+                return ByzantineProcess(
+                    pcfg, i, ptp, behavior=behaviors[i], **kwargs
+                )
+            return Process(pcfg, i, ptp, **kwargs)
+
+        sim = Simulation(
+            cfg, process_factory=factory if behaviors else None
+        )
+        sim.submit_blocks(per_process=2)
+        for _ in range(cycles):
+            sim.run(max_messages=sz * (sz - 1))
+        logs = [
+            [(v.id.round, v.id.source, v.digest()) for v in d]
+            for d in sim.deliveries
+        ]
+        return logs, sim, nbyz
+
+    matrix = (
+        (4, 1, None, 12),
+        (16, 5, "equivocate", 12),
+        (16, 6, "withhold", 40),
+        (32, 7, None, 8),
+        (64, 8, None, 8),
+    )
+    identity = []
+    for sz, seed, adversary, cycles in matrix:
+        off_logs, _, nbyz = one_side(sz, seed, adversary, False, cycles)
+        on_logs, sim, _ = one_side(sz, seed, adversary, True, cycles)
+        if not any(off_logs[nbyz:]):
+            raise AssertionError(
+                f"finality identity n={sz} {adversary}: oracle "
+                "delivered nothing — vacuous gate"
+            )
+        if off_logs != on_logs:
+            raise AssertionError(
+                f"finality identity n={sz} {adversary}: knobs-on "
+                "commit order diverged from the oracle"
+            )
+        eager_del = eager_rec = 0
+        for i, p in enumerate(sim.processes):
+            if i < nbyz:
+                continue
+            snap = p.metrics.snapshot()
+            if snap.get("eager_rollbacks_expected_zero", 0):
+                raise AssertionError(
+                    f"finality identity n={sz} {adversary}: eager "
+                    "rollback counter nonzero on an honest process"
+                )
+            eager_del += snap.get("eager_delivered", 0)
+            eager_rec += snap.get("eager_reconciled", 0)
+        if eager_del != eager_rec:
+            raise AssertionError(
+                f"finality identity n={sz} {adversary}: eager books "
+                f"unbalanced ({eager_del} surfaced, {eager_rec} "
+                "reconciled)"
+            )
+        identity.append(
+            {
+                "n": sz,
+                "seed": seed,
+                "adversary": adversary or "clean",
+                "delivered_view0": len(off_logs[nbyz]),
+                "eager_delivered": eager_del,
+            }
+        )
+
+    # -- half 2: wall-clock latency + attribution at n ---------------------
+
+    class _AttribDriver(ClusterLoadDriver):
+        """ClusterLoadDriver that splits every closed latency book into
+        its two exhaustive components at the same timestamps the total
+        uses, so component means sum to the total mean exactly."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.batch_wait = Histogram()
+            self.wave_lag = Histogram()
+            self.sum_batch = 0.0
+            self.sum_wave = 0.0
+            self.sum_total = 0.0
+            self.n_attr = 0
+            self._built_at = {}
+            for mp in self.mempools:
+                orig = mp.observe_delivered
+
+                def wrapped(block, now=None, mp=mp, orig=orig):
+                    t = mp.clock() if now is None else now
+                    for tx in block.transactions:
+                        t0 = mp._inflight.get(tx)
+                        if t0 is None:
+                            # not this view's transaction — leave the
+                            # build stamp for the origin mempool's pass
+                            continue
+                        tb = self._built_at.pop(tx, None)
+                        if tb is not None:
+                            bw = max(0.0, tb - t0)
+                            wl = max(0.0, t - tb)
+                            self.batch_wait.observe(bw)
+                            self.wave_lag.observe(wl)
+                            self.sum_batch += bw
+                            self.sum_wave += wl
+                            self.sum_total += max(0.0, t - t0)
+                            self.n_attr += 1
+                    orig(block, now=now)
+
+                mp.observe_delivered = wrapped
+
+        def _flush_batches(self, t, force=False):
+            now = None if self.wall else t
+            for i, mp in enumerate(self.mempools):
+                staged = len(self.sim.processes[i].blocks_to_propose)
+                blocks = mp.build_blocks(
+                    now=now, force=force, staged=staged
+                )
+                tb = mp.clock() if now is None else now
+                for b in blocks:
+                    for tx in b.transactions:
+                        if tx in mp._inflight:
+                            self._built_at[tx] = tb
+                    self.sim.processes[i].submit(b)
+                    self.submission_log.append((self.cycles, i, b))
+
+    sides: dict = {}
+    attribution: dict = {}
+    eager_lat = Histogram()
+    for path in ("off", "on"):
+        on = path == "on"
+        cfg = Config(
+            n=n,
+            coin="round_robin",
+            propose_empty=True,
+            gc_depth=24,
+            wave_pipeline=on,
+            eager_deliver=on,
+        )
+        sim = Simulation(cfg)
+        gen = LoadGenerator(
+            clients=32, rate=rate, tx_bytes=32, seed=16, profile="poisson"
+        )
+        drv = _AttribDriver(
+            sim,
+            gen,
+            mcfg=MempoolConfig(
+                cap=65536, batch_bytes=4096, adaptive_deadline=on
+            ),
+            wall=True,
+        )
+        if on:
+            # submit→early-surface latency: the optimistic finality a
+            # client acting on the speculative stream would see (books
+            # stay open — the canonical a_deliver still closes them)
+            for p, mp, esink in zip(
+                sim.processes, drv.mempools, sim.eager_deliveries
+            ):
+
+                def early(v, mp=mp, esink=esink):
+                    t = mp.clock()
+                    for tx in v.block.transactions:
+                        t0 = mp._inflight.get(tx)
+                        if t0 is not None:
+                            eager_lat.observe(max(0.0, t - t0))
+                    esink.append(v)
+
+                p.on_deliver_early = early
+        entry = drv.run(wall_s, drain_s=drain_s)
+        sim.check_agreement()
+        if entry["audit"]["lost"] or entry["audit"]["duplicates"]:
+            raise AssertionError(
+                f"finality {path}: audit failed: {entry['audit']}"
+            )
+        entry["verifier"] = "none"
+        if drv.n_attr:
+            mean_batch = 1e3 * drv.sum_batch / drv.n_attr
+            mean_wave = 1e3 * drv.sum_wave / drv.n_attr
+            mean_total = 1e3 * drv.sum_total / drv.n_attr
+            snap = sim.processes[0].metrics.snapshot()
+            attribution[path] = {
+                "samples": drv.n_attr,
+                # queueing: submit → block built (the batcher's hold)
+                "batch_wait_ms_mean": round(mean_batch, 3),
+                "batch_wait_ms_p50": round(
+                    1e3 * drv.batch_wait.percentile(50), 3
+                ),
+                # wave lag: block built → a_deliver (admission + DAG
+                # rounds + wave commit + flush); the host pump floor
+                # rides inside it and is reported for context
+                "wave_lag_ms_mean": round(mean_wave, 3),
+                "wave_lag_ms_p50": round(
+                    1e3 * drv.wave_lag.percentile(50), 3
+                ),
+                "total_ms_mean": round(mean_total, 3),
+                "host_pump_ms_per_round": snap.get(
+                    "host_pump_ms_per_round"
+                ),
+                "deadline_ms_effective": snap.get("deadline_ms_effective"),
+            }
+            if abs(mean_total - (mean_batch + mean_wave)) > 0.05:
+                raise AssertionError(
+                    f"finality {path}: attribution components do not "
+                    f"sum to the measured total ({mean_batch:.3f} + "
+                    f"{mean_wave:.3f} != {mean_total:.3f} ms)"
+                )
+        sides[path] = entry
+
+    p50_on = sides["on"].get("submit_deliver_p50_ms")
+    entry = {
+        "nodes": n,
+        "wall_s": wall_s,
+        "offered_rate": rate,
+        "identity": identity,
+        # half 1 raises on divergence, so reaching here means the gate
+        # held across the whole matrix
+        "commit_order_identical": True,
+        "off": sides["off"],
+        "on": sides["on"],
+        "attribution": attribution,
+        "p50_under_1s": bool(p50_on is not None and p50_on < 1000.0),
+    }
+    if len(eager_lat):
+        entry["submit_eager_p50_ms"] = round(
+            1e3 * eager_lat.percentile(50), 3
+        )
+    return entry
+
+
 def _agg_ladder_rung(sizes=(64, 256)) -> dict:
     """verify_n256_agg ladder rung (round 13): component costs of the
     aggregated round-certificate check at committee quorums vs the
@@ -1890,6 +2181,62 @@ def _measure() -> None:
             _mark(f"ladder mempool_chaos FAILED: {e!r}")
     else:
         _mark(f"skipping ladder mempool_chaos (left {left():.0f}s)")
+
+    # -- ladder rung (ISSUE 16): submit→deliver finality — pipelined
+    # waves + eager optimistic delivery. Half 1 is the byte-identity
+    # gate over the seeded n × adversary matrix (the rung RAISES on any
+    # divergence, unbalanced eager books, or a nonzero expected-zero
+    # rollback counter); half 2 is the wall-clock knobs-on/off latency
+    # A/B at n=64 with the per-transaction attribution split (batcher
+    # queueing vs wave lag, components summing to the measured total).
+    fin_s = float(os.environ.get("DAGRIDER_BENCH_FINALITY_S", "15"))
+    fin_n = int(os.environ.get("DAGRIDER_BENCH_FINALITY_N", "64"))
+    fin_rate = float(os.environ.get("DAGRIDER_BENCH_FINALITY_RATE", "2000"))
+    if fin_s > 0 and left() > 2 * fin_s + 80:
+        _mark(f"ladder finality: n={fin_n}, {fin_s:.0f}s wall per side")
+        try:
+            t_rung = time.monotonic()
+            entry = _finality_rung(
+                n=fin_n, wall_s=fin_s, rate=fin_rate, drain_s=30.0
+            )
+            entry["rung_seconds"] = round(time.monotonic() - t_rung, 1)
+            result["ladder"]["finality"] = entry
+            _mark(
+                f"ladder finality: identity gate held over "
+                f"{len(entry['identity'])} matrix cases, p50 "
+                f"{entry['on'].get('submit_deliver_p50_ms')} ms on / "
+                f"{entry['off'].get('submit_deliver_p50_ms')} ms off, "
+                f"eager p50 {entry.get('submit_eager_p50_ms')} ms, "
+                f"sub-second gate "
+                f"{'OK' if entry['p50_under_1s'] else 'MISSED'}"
+            )
+            emit()
+            import datetime as _dt
+
+            from dag_rider_tpu import config as _cfg
+
+            out_path = os.path.join(
+                _REPO, _cfg.env_str("DAGRIDER_FINALITY_OUT")
+            )
+            with open(out_path, "w") as fh:
+                json.dump(
+                    {
+                        "schema": "dag-rider-tpu/bench-finality/v1",
+                        "captured": _dt.datetime.now().isoformat(
+                            timespec="seconds"
+                        ),
+                        "backend": result.get("backend", "cpu"),
+                        "finality": entry,
+                    },
+                    fh,
+                    indent=1,
+                )
+                fh.write("\n")
+            _mark(f"ladder finality: wrote {out_path}")
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder finality FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder finality (left {left():.0f}s)")
 
     # -- ladder rung: Byzantine adversary x WAN suite at committee scale.
     # Every adversary class from consensus/adversary.py drives f=10 of

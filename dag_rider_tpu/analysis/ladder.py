@@ -97,6 +97,21 @@ LADDERS: Tuple[Ladder, ...] = (
         "dag_rider_tpu.ops.bls_pairing.multi_pairing_check",
         "dag_rider_tpu.crypto.bls12381.multi_pairing_check",
     ),
+    # pipelined per-round wave attempts vs the 4-round boundary sweep
+    Ladder(
+        "DAGRIDER_WAVE_PIPELINE",
+        _P + "step",
+        _P + "_try_waves_pipelined",
+        _P + "_try_advance",
+    ),
+    # eager speculative surface vs the coin-ordered canonical walk (the
+    # walk is also the reconciliation oracle for what eager surfaced)
+    Ladder(
+        "DAGRIDER_EAGER_DELIVER",
+        _P + "_try_wave",
+        _P + "_eager_surface",
+        _P + "_order_vertices",
+    ),
 )
 
 

@@ -100,6 +100,9 @@ _register("DAGRIDER_ADMIT_WATERMARKS", "str", "",
           'admission watermarks as "low,high" pool-fill fractions')
 _register("DAGRIDER_MEMPOOL_TTL_S", "float", 60.0,
           "pending-transaction eviction age in seconds")
+_register("DAGRIDER_ADAPTIVE_DEADLINE", "flag", False,
+          "drive the batcher's effective deadline from the live "
+          "submit->deliver latency histogram (ISSUE 16 tentpole 3)")
 _register("DAGRIDER_PROFILE_DIR", "str", "",
           "jax.profiler trace output directory for bench runs")
 _register("DAGRIDER_AGG_OUT", "str", "BENCH_r06.json",
@@ -132,6 +135,14 @@ _register("DAGRIDER_FLIGHT_DIR", "str", "",
           "flight-recorder dump directory (empty disables dumps)")
 _register("DAGRIDER_FLIGHT_EVENTS", "int", 4096,
           "events retained in the flight-recorder last-N ring", minimum=1)
+_register("DAGRIDER_WAVE_PIPELINE", "flag", False,
+          "pipelined wave evaluation (decide each wave the step its "
+          "commit-round quorum lands instead of at the 4-round boundary)")
+_register("DAGRIDER_EAGER_DELIVER", "flag", False,
+          "optimistic early delivery: surface each decided chunk via "
+          "on_deliver_early ahead of the deferred canonical flush")
+_register("DAGRIDER_FINALITY_OUT", "str", "BENCH_r08.json",
+          "finality-ladder bench output path")
 
 
 def _raw(name: str) -> str:
@@ -324,6 +335,27 @@ class Config:
     # (default on); peers verify independently either way, so turning
     # it off trades early local detection for assembly latency.
     cert_selfcheck: Optional[bool] = None
+    # Pipelined wave evaluation (ISSUE 16 tentpole 1): instead of the
+    # one-shot attempt at each 4-round boundary, every undecided wave
+    # whose commit round has a quorum is (re)evaluated each step, so a
+    # wave decides the moment its votes land rather than when the local
+    # round counter happens to cross the boundary. The decided leader
+    # chain — and therefore the total order — is unchanged (covering
+    # lemma: a quorum of round-4w votes for L_w guarantees every later
+    # leader strong-reaches L_w, so the retroactive walk is invariant
+    # to attempt timing); tests pin byte-identity against the scalar
+    # oracle. None resolves from DAGRIDER_WAVE_PIPELINE; explicit beats
+    # env, like pump/cert.
+    wave_pipeline: Optional[bool] = None
+    # Eager optimistic delivery (ISSUE 16 tentpole 2): surface each
+    # decided wave's exact canonical chunk through on_deliver_early at
+    # DECISION time, ahead of the (possibly deferred) canonical
+    # _order_vertices flush, and reconcile the speculative log against
+    # the canonical order when the flush runs. The speculative stream
+    # is a prefix of the final order by construction; a reconciliation
+    # mismatch is an invariant violation routed through the flight
+    # recorder. None resolves from DAGRIDER_EAGER_DELIVER.
+    eager_deliver: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -353,6 +385,14 @@ class Config:
         if self.cert_selfcheck is None:
             object.__setattr__(
                 self, "cert_selfcheck", env_flag("DAGRIDER_CERT_SELFCHECK")
+            )
+        if self.wave_pipeline is None:
+            object.__setattr__(
+                self, "wave_pipeline", env_flag("DAGRIDER_WAVE_PIPELINE")
+            )
+        if self.eager_deliver is None:
+            object.__setattr__(
+                self, "eager_deliver", env_flag("DAGRIDER_EAGER_DELIVER")
             )
         if self.f is None:
             object.__setattr__(self, "f", (self.n - 1) // 3)
@@ -456,6 +496,15 @@ class MempoolConfig:
     source_burst: float = 32.0
     max_batch_txs: int = 1024
     max_staged_blocks: int = 16
+    #: ISSUE 16 tentpole 3 (DAGRIDER_ADAPTIVE_DEADLINE): when True the
+    #: Mempool drives the batcher's EFFECTIVE deadline from the live
+    #: submit→deliver histogram — a 50 ms hold is noise against a 10 s
+    #: end-to-end path but a third of a sub-second one, so the deadline
+    #: tracks a small fraction of the measured p50 (floored at 1 ms,
+    #: capped at the configured batch_deadline_ms). Off by default:
+    #: adaptive packing changes block contents, so byte-identity A/B
+    #: suites must keep it off.
+    adaptive_deadline: bool = False
 
     def __post_init__(self) -> None:
         if self.cap < 1:
@@ -508,6 +557,7 @@ class MempoolConfig:
             admit_low=low,
             admit_high=high,
             ttl_s=env_float("DAGRIDER_MEMPOOL_TTL_S", cls.ttl_s),
+            adaptive_deadline=env_flag("DAGRIDER_ADAPTIVE_DEADLINE"),
         )
 
     @staticmethod

@@ -290,6 +290,18 @@ class DagState:
     def round_size(self, rnd: int) -> int:
         return len(self._round_vertices.get(rnd, ()))
 
+    def quorum_frontier(self, quorum: int) -> int:
+        """Highest round whose vertex count reaches ``quorum`` (0 when
+        only genesis does). Round fills are monotone downward —
+        admission requires >= quorum strong edges into every prior
+        round — so a backward scan from ``max_round`` stops at the
+        first hit. The pipelined wave pass uses this to bound which
+        wave instances can possibly have quorum votes yet."""
+        for r in range(self.max_round, 0, -1):
+            if self.round_size(r) >= quorum:
+                return r
+        return 0
+
     def vertices_in_round(self, rnd: int) -> List[Vertex]:
         """Vertices of one round in ascending-source order (the
         deterministic order proposals and total-order delivery rely on).

@@ -76,6 +76,7 @@ class Process:
         cert_signer=None,
         cert_verifier=None,
         on_deliver: Optional[DeliverCallback] = None,
+        on_deliver_early: Optional[DeliverCallback] = None,
         log: EventLog = NOOP,
     ) -> None:
         if not 0 <= index < cfg.n:
@@ -89,6 +90,13 @@ class Process:
         self.cert_signer = cert_signer
         self.cert_verifier = cert_verifier
         self.on_deliver = on_deliver
+        #: speculative a_deliver seam (ISSUE 16): with cfg.eager_deliver
+        #: a decided wave's canonical chunk is surfaced here at DECISION
+        #: time, ahead of the (possibly deferred) on_deliver flush. The
+        #: stream is a prefix of the final order by construction;
+        #: _order_vertices reconciles and treats divergence as an
+        #: invariant violation.
+        self.on_deliver_early = on_deliver_early
         # Structured event log (SURVEY §5 L5; the reference has 3 zap
         # Debug sites — here every state transition emits a typed event).
         # NOOP by default: one attribute test per call site.
@@ -261,6 +269,43 @@ class Process:
         # whenever it runs, and FIFO flushing preserves delivery order.
         self.defer_delivery = False
         self._deferred_orders: Deque = deque()
+        # -- pipelined waves + eager delivery (ISSUE 16) ---------------
+        #: cfg.wave_pipeline: every undecided wave whose commit round
+        #: holds a quorum is (re)attempted each step by
+        #: _try_waves_pipelined instead of once at the 4-round boundary.
+        self._pipelined_waves = bool(cfg.wave_pipeline)
+        #: vertices dispatched through a hold-tail verifier window whose
+        #: masks have not come back yet (FIFO = dispatch = resolve order)
+        self._verify_owed: Deque[Vertex] = deque()
+        #: waves whose boundary-equivalent attempt (round counter at or
+        #: past the commit round) has been taken — the pipelined twin of
+        #: the oracle's _waves_tried one-shot bookkeeping
+        self._waves_spent: Set[int] = set()
+        #: wave -> (round_size(r4), round_size(r1)) at the last early
+        #: attempt; votes and leader presence are pure functions of
+        #: those fills, so an unchanged pair means an unchanged verdict
+        self._wave_try_memo: Dict[int, tuple] = {}
+        #: cfg.eager_deliver: speculative delivery log + its own dense
+        #: mask (the eager twin of delivered_log/_delivered_mask) and
+        #: the reconciliation cursor _order_vertices advances
+        self._eager = bool(cfg.eager_deliver)
+        self.eager_log: List[VertexID] = []
+        self._eager_cursor = 0
+        self._eager_mask = (
+            np.zeros_like(self.dag.exists) if self._eager else None
+        )
+        if self._eager:
+            # visible-at-zero gauges: "0 mismatches" must be
+            # distinguishable from "eager path absent" in snapshots
+            self.metrics.counters["eager_rollbacks_expected_zero"] = 0
+            self.metrics.counters["eager_delivered"] = 0
+            # Cert-quorum optimism needs no extra wiring here: a
+            # certificate applied in _apply_certificate admits its
+            # round inside the same step() loop, so the pipelined wave
+            # pass decides — and the eager surface fires — the moment
+            # the round-certificate quorum forms. The CertVerifier's
+            # on_certified seam (verifier/cert.py) is for SINGLE-owner
+            # stacks (node.py); the simulator's verifier is shared.
 
         transport.subscribe(index, self.on_message)
 
@@ -699,13 +744,55 @@ class Process:
 
     def _drain_verify(self) -> None:
         """Batch-verify queued vertices through the Verifier seam — one
-        whole batch per dispatch (the north-star shape)."""
+        whole batch per dispatch (the north-star shape).
+
+        Under cfg.wave_pipeline with a windowed verifier (node.py wires
+        a VerifierPipeline directly as ``self.verifier``), the dispatch
+        window spans pump cycles (ISSUE 16 tentpole 4): each pass ships
+        this cycle's batch with ``hold_tail=True`` so up to depth-1
+        chunks stay in flight on the device while the host runs the
+        next transport pump, and applies whatever masks resolved —
+        which cover the OLDEST owed vertices in FIFO dispatch order.
+        :meth:`_flush_verify_owed` settles the remainder at quiescence,
+        so admission is only ever deferred, never lost. The lockstep
+        simulator keeps its own full-drain coalescing path
+        (take_verify_batch/apply_verify_mask) — byte-identity of its
+        A/B runs is argued there."""
         if not self._pending_verify:
             return
         batch = self.take_verify_batch()
+        rc = getattr(self.verifier, "run_coalesced", None)
+        if (
+            self._pipelined_waves
+            and callable(rc)
+            and callable(getattr(self.verifier, "drain", None))
+        ):
+            with Timer() as t:
+                ok = rc(batch, hold_tail=True)
+            self._verify_owed.extend(batch)
+            if ok:
+                front = [
+                    self._verify_owed.popleft() for _ in range(len(ok))
+                ]
+                self.apply_verify_mask(front, ok, t.seconds)
+            return
         with Timer() as t:
             ok = self.verifier.verify_batch(batch)
         self.apply_verify_mask(batch, ok, t.seconds)
+
+    def _flush_verify_owed(self) -> bool:
+        """Resolve every mask still held across pump cycles by the
+        hold-tail window (see :meth:`_drain_verify`) and admit/reject
+        the owed vertices. Called at step() quiescence: when no other
+        transition can fire, the held tail is the only possible source
+        of progress left."""
+        if not self._verify_owed:
+            return False
+        with Timer() as t:
+            ok = self.verifier.drain()
+        front = [self._verify_owed.popleft() for _ in range(len(ok))]
+        self.apply_verify_mask(front, ok, t.seconds)
+        return bool(front)
 
     # ------------------------------------------------------------------
     # Aggregated round certificates (ISSUE 9)
@@ -1077,8 +1164,15 @@ class Process:
             self._drain_verify()
             progress |= self._drain_buffer()
             progress |= self._try_advance()
+            if self._pipelined_waves:
+                progress |= self._try_waves_pipelined()
             progress |= self._retry_pending_waves()
             made_progress |= progress
+            if not progress and self._verify_owed:
+                # quiescent with masks still in the hold-tail window:
+                # settle them now — the held tail is the only remaining
+                # source of admissions
+                progress |= self._flush_verify_owed()
             if not progress and self._cert and not cert_ticked:
                 # one patience tick per step(), taken only at quiescence
                 # so a timeout-degraded round drains in THIS step
@@ -1379,7 +1473,14 @@ class Process:
             # wave needs no new proposal (the paper's wave_ready is an
             # independent upon-clause), so an idle client must not stall
             # delivery of a completed wave.
-            if r > 0 and r % self.cfg.wave_length == 0:
+            if (
+                r > 0
+                and r % self.cfg.wave_length == 0
+                and not self._pipelined_waves
+            ):
+                # cfg.wave_pipeline delegates every attempt to the
+                # per-step _try_waves_pipelined pass (same step, same
+                # DAG state — decisions land no later, never differ)
                 w = r // self.cfg.wave_length
                 if w not in self._waves_tried:
                     self._waves_tried.add(w)
@@ -1813,27 +1914,111 @@ class Process:
                 fired = True
         return fired
 
-    def _try_wave(self, wave: int) -> None:
+    def _try_waves_pipelined(self) -> bool:
+        """Attempt every live undecided wave whose commit round already
+        holds a quorum (ISSUE 16 tentpole 1; cfg.wave_pipeline).
+
+        The boundary one-shot in _try_advance serializes wave
+        evaluation behind the local round counter: a wave whose votes
+        land mid-step waits for the counter to cross round(w, 4), and a
+        wave that fails its single boundary attempt is only ever
+        committed retroactively through a later wave's chain walk. Here
+        every wave from decided_wave+1 up to the DAG's quorum frontier
+        is (re)attempted each pass, so a decision lands the moment its
+        votes exist and undecided waves stay retryable while younger
+        waves fill — overlapping wave instances instead of a lockstep
+        4-round cadence.
+
+        The committed leader sequence — and therefore the total order —
+        is unchanged (the A/B invariant): chain-walk path checks run
+        over the deciding leader's immutable causal past, so they are
+        time-invariant, and a wave's one-shot is spent exactly at the
+        first attempt with the round counter at/past its commit round —
+        the same DAG state the oracle's boundary attempt sees — so no
+        wave decides here that the boundary path would have skipped
+        (decisions land earlier in the step, never different).
+        """
+        wl = self.cfg.wave_length
+        frontier = self.dag.quorum_frontier(self.cfg.quorum)
+        if frontier < wl:
+            self.metrics.counters["waves_inflight"] = 0
+            return False
+        before = self.decided_wave
+        w_hi = self.cfg.wave_of_round(frontier)
+        for w in range(self.decided_wave + 1, w_hi + 1):
+            r4 = self.cfg.wave_round(w, wl)
+            if r4 > frontier:
+                break
+            if w <= self.decided_wave or w in self._waves_spent:
+                continue
+            spend = self.round >= r4
+            if spend:
+                # boundary-equivalent attempt: one-shot spent, exactly
+                # like the oracle's _waves_tried bookkeeping
+                self._waves_spent.add(w)
+                self._wave_try_memo.pop(w, None)
+                self._try_wave(w)
+                continue
+            # early retryable attempt: votes and leader presence are
+            # pure functions of the r4/r1 fills (strong edges are fixed
+            # at admission), so an unchanged fill pair means the last
+            # verdict stands — skip the reach count
+            fills = (
+                self.dag.round_size(r4),
+                self.dag.round_size(self.cfg.wave_round(w, 1)),
+            )
+            if self._wave_try_memo.get(w) == fills:
+                continue
+            self._wave_try_memo[w] = fills
+            self._try_wave(w, quiet=True)
+        if self.decided_wave > before:
+            self._waves_spent = {
+                w for w in self._waves_spent if w > self.decided_wave
+            }
+            self._wave_try_memo = {
+                w: m
+                for w, m in self._wave_try_memo.items()
+                if w > self.decided_wave
+            }
+        # gauge: undecided waves whose commit round has a quorum — the
+        # live overlap depth of the wave pipeline
+        self.metrics.counters["waves_inflight"] = max(
+            0,
+            min(w_hi, self.cfg.wave_of_round(frontier))
+            - self.decided_wave,
+        )
+        return self.decided_wave > before
+
+    def _try_wave(self, wave: int, quiet: bool = False) -> None:
         """The commit rule (reference ``waveReady``, ``process.go:312-354``,
-        with D4/D5 fixed: state persists and ordering actually runs)."""
+        with D4/D5 fixed: state persists and ordering actually runs).
+
+        ``quiet`` marks a retryable pipelined attempt: a failed quorum
+        or absent leader is expected to be re-tried as the DAG fills,
+        so it must not inflate ``waves_skipped`` or spam skip events —
+        the spend-time attempt (and the oracle boundary path) keeps the
+        reference accounting."""
         if wave <= self.decided_wave:
             return
         if not self.coin.ready(wave):
             self._pending_waves.add(wave)
-            self.log.event("wave_pending_coin", wave=wave)
+            if not quiet:
+                self.log.event("wave_pending_coin", wave=wave)
             return
         leader = self._wave_leader(wave)
         if leader is None:
-            self.metrics.inc("waves_skipped")
-            self.log.event("wave_skip", wave=wave, reason="no_leader")
+            if not quiet:
+                self.metrics.inc("waves_skipped")
+                self.log.event("wave_skip", wave=wave, reason="no_leader")
             return
         r4, r1 = self.cfg.wave_round(wave, self.cfg.wave_length), self.cfg.wave_round(wave, 1)
         votes = self._strong_reach_count(r4, r1, leader.source)
         if votes < self.cfg.quorum:
-            self.metrics.inc("waves_skipped")
-            self.log.event(
-                "wave_skip", wave=wave, reason="quorum", votes=votes
-            )
+            if not quiet:
+                self.metrics.inc("waves_skipped")
+                self.log.event(
+                    "wave_skip", wave=wave, reason="quorum", votes=votes
+                )
             return
         # Retroactive leader chain (process.go:341-350): walk back through
         # undecided waves, committing every prior leader the current one
@@ -1884,6 +2069,12 @@ class Process:
             votes=votes,
             chain=len(leaders),
         )
+        if self._eager:
+            # surface the exact canonical chunks NOW, ahead of the
+            # (possibly deferred) on_deliver flush — list(leaders)
+            # iterates in pop order (oldest leader first) without
+            # consuming the stack the flush still owns
+            self._eager_surface(list(leaders), wave)
         if self.defer_delivery:
             # cur is the oldest leader in the chain — maybe_prune anchors
             # the GC floor on it until the deferred walk flushes.
@@ -1894,6 +2085,95 @@ class Process:
         self._order_vertices(leaders)
         self.metrics.observe_wave_commit(_time.perf_counter() - t0)
         self.maybe_prune()
+
+    def _eager_surface(self, chain: List[Vertex], wave: int) -> None:
+        """Speculatively surface a decided chain's canonical chunks
+        (ISSUE 16 tentpole 2; cfg.eager_deliver).
+
+        The chunks computed here are byte-identical to what the
+        canonical _order_vertices walk will deliver for the same chain:
+        a leader's closure is immutable once admitted (admission
+        requires full causal history), the GC exclusion bound is a pure
+        function of the leader round, and the eager mask has exactly
+        the prior decisions' chunks applied (decisions and flushes are
+        both FIFO). So the speculative stream is a prefix of the final
+        order by construction; _order_vertices reconciles and routes
+        any divergence through the flight recorder."""
+        mask = self._eager_mask
+        if mask.shape[0] < self.dag.exists.shape[0]:
+            grown = np.zeros_like(self.dag.exists)
+            grown[: mask.shape[0]] = mask
+            self._eager_mask = mask = grown
+        base = self.dag.base_round
+        gc = self.cfg.gc_depth
+        cb = self.on_deliver_early
+        by_round = self.dag._round_vertices
+        count = 0
+        for leader in chain:
+            reached = self.dag.closure_stopped(leader.id, mask)
+            lo_round = max(1, base + 1)
+            if gc is not None:
+                lo_round = max(lo_round, leader.round - gc + 1)
+            lo = lo_round - base
+            hi = leader.round + 1 - base
+            if hi <= lo:
+                continue
+            fresh = reached[lo:hi] & ~mask[lo:hi]
+            rrs, srcs = np.nonzero(fresh)
+            if not rrs.size:
+                continue
+            mask[lo:hi][fresh] = True
+            cur = -1
+            d: Dict[int, Vertex] = {}
+            for rr, src in zip(rrs.tolist(), srcs.tolist()):
+                if rr != cur:
+                    cur = rr
+                    d = by_round[rr + lo_round]
+                v = d[src]
+                self.eager_log.append(v.id)
+                if cb is not None:
+                    cb(v)
+            count += int(rrs.size)
+        if count:
+            self.metrics.inc("eager_delivered", count)
+            self.log.event("eager_deliver", wave=wave, count=count)
+
+    def _reconcile_eager(self, n_before: int) -> None:
+        """Match canonical deliveries just appended by _order_vertices
+        against the speculative stream (prefix property). The canonical
+        order always wins — the eager stream is advisory — so a
+        mismatch never rolls back delivered state; it bumps the
+        expected-zero counter, fires the flight-recorder trigger, and
+        disables further speculation on this process."""
+        fresh = self.delivered_log[n_before:]
+        if not fresh:
+            return
+        cur = self._eager_cursor
+        elog = self.eager_log
+        ok = 0
+        for vid in fresh:
+            if cur < len(elog) and elog[cur] == vid:
+                cur += 1
+                ok += 1
+                continue
+            self.metrics.inc("eager_rollbacks_expected_zero")
+            self.log.event(
+                "eager_mismatch",
+                cursor=cur,
+                expected=str(elog[cur]) if cur < len(elog) else None,
+                delivered=str(vid),
+            )
+            self.log.event(
+                "invariant_violation",
+                kind="eager_prefix",
+                detail=f"speculative order diverged at cursor {cur}",
+            )
+            self._eager = False
+            break
+        self._eager_cursor = cur
+        if ok:
+            self.metrics.inc("eager_reconciled", ok)
+            self.log.event("eager_reconciled", count=ok)
 
     def flush_deliveries(self) -> None:
         """Run queued ordering/delivery walks (see ``defer_delivery``).
@@ -1935,6 +2215,27 @@ class Process:
         m = min(src.shape[0], new.shape[0])
         new[:m] = src[:m]
         self._delivered_mask = new
+        if self._eager_mask is not None:
+            # the eager twin shifts with the same realignment, and the
+            # reconciled head of the speculative log retires with the
+            # canonical one (entries past the cursor are still awaiting
+            # their canonical match and must survive the prune)
+            enew = np.zeros_like(self.dag.exists)
+            esrc = self._eager_mask[shift:]
+            em = min(esrc.shape[0], enew.shape[0])
+            enew[:em] = esrc[:em]
+            self._eager_mask = enew
+            nb = self.dag.base_round
+            drop = 0
+            while (
+                drop < self._eager_cursor
+                and drop < len(self.eager_log)
+                and self.eager_log[drop].round < nb
+            ):
+                drop += 1
+            if drop:
+                self.eager_log = self.eager_log[drop:]
+                self._eager_cursor -= drop
         # Bound the book-keeping that grows with history. delivered_log
         # keeps only the live window (the trimmed count is preserved for
         # checkpoints/metrics); deliveries below the horizon can never
@@ -1994,6 +2295,14 @@ class Process:
         self._pending_waves = {
             w
             for w in self._pending_waves
+            if self.cfg.wave_round(w, 1) > base
+        }
+        self._waves_spent = {
+            w for w in self._waves_spent if self.cfg.wave_round(w, 1) > base
+        }
+        self._wave_try_memo = {
+            w: f
+            for w, f in self._wave_try_memo.items()
             if self.cfg.wave_round(w, 1) > base
         }
         self.metrics.inc("vertices_pruned", removed)
@@ -2149,6 +2458,8 @@ class Process:
             count=len(self.delivered_log) - n_before,
             total=len(self.delivered_log),
         )
+        if self._eager_mask is not None and self._eager:
+            self._reconcile_eager(n_before)
 
     @property
     def delivered(self) -> Set[VertexID]:
@@ -2165,3 +2476,10 @@ class Process:
         for vid in self.delivered_log:
             if vid.round >= base:
                 self._delivered_mask[vid.round - base, vid.source] = True
+        if self._eager_mask is not None:
+            # a wholesale log replacement (checkpoint restore) voids the
+            # speculative stream: restart it from the canonical state so
+            # nothing already delivered is ever re-surfaced
+            self._eager_mask = self._delivered_mask.copy()
+            self.eager_log = []
+            self._eager_cursor = 0

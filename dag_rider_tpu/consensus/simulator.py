@@ -144,6 +144,19 @@ class Simulation:
                 )
             )
         self._rbc = rbc
+        # Eager optimistic delivery (ISSUE 16): each process's
+        # speculative stream lands in its own sink, mirroring
+        # self.deliveries — wired post-construction so the
+        # process_factory seam (ByzantineProcess and friends) keeps the
+        # plain Process signature. The finality suite asserts each sink
+        # is a prefix-complete copy of the canonical one.
+        self.eager_deliveries: List[List[Vertex]] = [
+            [] for _ in range(cfg.n)
+        ]
+        if cfg.eager_deliver:
+            for p, esink in zip(self.processes, self.eager_deliveries):
+                if getattr(p, "on_deliver_early", None) is None:
+                    p.on_deliver_early = esink.append
         if self.flight is not None:
             # a dump captures every process's full counter state
             for p in self.processes:

@@ -188,6 +188,8 @@ class Mempool:
         ignores the bound."""
         with self._lock:
             t = self.clock() if now is None else now
+            if self.cfg.adaptive_deadline:
+                self._adapt_deadline()
             for tx in self.pool.expire(t):
                 self._inflight.pop(tx, None)
             limit: Optional[int] = None
@@ -208,6 +210,38 @@ class Mempool:
                         for k in keys:
                             self.log.event("tx_batch", tx=k, block=bk)
             return blocks
+
+    def _adapt_deadline(self) -> None:
+        """Retune the batcher's effective deadline from the live
+        submit→deliver histogram (ISSUE 16 tentpole 3,
+        cfg.adaptive_deadline). The hold deadline should be a small tax
+        on what the client already waits end to end: target 5% of the
+        measured p50, floored at 1 ms (never busy-ship every single
+        transaction) and capped at the configured ``batch_deadline_ms``
+        (never hold LONGER than the operator allowed). Until enough
+        samples exist the configured value stands. Caller holds the
+        lock."""
+        if self.latency.count < 16:
+            return
+        p50_ms = self.latency.percentile(50.0) * 1e3
+        eff = min(
+            float(self.cfg.batch_deadline_ms), max(1.0, 0.05 * p50_ms)
+        )
+        prev = self.batcher.deadline_ms
+        if abs(eff - prev) < 0.5:
+            return
+        self.batcher.deadline_ms = eff
+        if self.metrics is not None:
+            # gauge, not a counter: latest effective value wins
+            self.metrics.counters["deadline_ms_effective"] = int(
+                round(eff)
+            )
+        self.log.event(
+            "deadline_adapted",
+            deadline_ms=round(eff, 3),
+            prev_ms=round(prev, 3),
+            p50_ms=round(p50_ms, 3),
+        )
 
     def observe_delivered(
         self, block: Block, now: Optional[float] = None

@@ -36,6 +36,10 @@ class BlockBatcher:
     def __init__(self, cfg: MempoolConfig, pool: TransactionPool) -> None:
         self.cfg = cfg
         self.pool = pool
+        #: the EFFECTIVE deadline: starts at the configured value and is
+        #: retuned live by Mempool when cfg.adaptive_deadline is on (the
+        #: configured batch_deadline_ms stays the ceiling)
+        self.deadline_ms = float(cfg.batch_deadline_ms)
         self.blocks_built = 0
         self.txs_packed = 0
         self.fill_fractions: Deque[float] = deque(maxlen=_FILL_WINDOW)
@@ -45,9 +49,7 @@ class BlockBatcher:
             return False
         if self.pool.depth_bytes >= self.cfg.batch_bytes:
             return True
-        return (
-            self.pool.oldest_age(now) * 1e3 >= self.cfg.batch_deadline_ms
-        )
+        return self.pool.oldest_age(now) * 1e3 >= self.deadline_ms
 
     def build(self, now: float, force: bool = False) -> Optional[Block]:
         """One block if a trigger fired (or ``force`` and non-empty)."""
@@ -69,18 +71,19 @@ class BlockBatcher:
         force: bool = False,
         limit: Optional[int] = None,
     ) -> List[Block]:
-        """Every block whose trigger has fired, up to ``limit``. At most
-        one deadline-triggered *partial* block per call — the rest only
-        ship full (draining a deep pool into a run of near-empty blocks
-        would waste vertex slots); ``force`` flushes everything
-        regardless of triggers (but still honors ``limit``)."""
+        """Every block whose trigger has fired, up to ``limit``. The
+        triggers are re-checked against the REMAINING pool before each
+        build: several client lanes that independently aged past the
+        deadline each earn their own partial block in one call (the old
+        size-only re-check spent the deadline trigger on the first
+        build, so lane 2's overdue traffic waited a full extra drain
+        cycle — the one-partial-per-drain bug). Termination: build()
+        always takes at least one transaction, so the pool strictly
+        shrinks. ``force`` flushes everything regardless of triggers
+        (but still honors ``limit``)."""
         out: List[Block] = []
         while limit is None or len(out) < limit:
-            # after the first build the deadline trigger is spent for
-            # this call; further blocks must earn the size trigger
-            if not force and out and (
-                self.pool.depth_bytes < self.cfg.batch_bytes
-            ):
+            if not force and out and not self.ready(now):
                 break
             block = self.build(now, force=force)
             if block is None:

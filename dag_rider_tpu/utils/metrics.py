@@ -46,6 +46,12 @@ KNOWN_COUNTERS = frozenset(
         "rounds_advanced",
         "waves_decided",
         "waves_skipped",
+        # pipelined waves + eager delivery (ISSUE 16)
+        "waves_inflight",
+        "eager_delivered",
+        "eager_reconciled",
+        "eager_rollbacks_expected_zero",
+        "deadline_ms_effective",
         "sync_requested",
         "sync_attested_floor_raises",
         "sync_nacks",

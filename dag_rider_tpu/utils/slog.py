@@ -51,6 +51,11 @@ KNOWN_EVENTS = frozenset(
         "wave_pending_chain_coin",
         "wave_pending_coin",
         "wave_skip",
+        # pipelined waves + eager optimistic delivery (ISSUE 16)
+        "eager_deliver",
+        "eager_reconciled",
+        "eager_mismatch",
+        "deadline_adapted",
         # aggregated certificates + cert-of-certs
         "cert_assembled",
         "cert_degraded",

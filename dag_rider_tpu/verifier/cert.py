@@ -29,7 +29,7 @@ simulator's dedup'd shared per-vertex dispatch.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from dag_rider_tpu import config
 from dag_rider_tpu.core.types import RoundCertificate, SpanCertificate
@@ -95,6 +95,14 @@ class CertVerifier:
         self.pair = _resolve_pair(pair)
         self._sharded = None
         self._verdicts: dict = {}
+        #: Optional callback fired once per certificate, on its FIRST
+        #: successful verification (memo hits stay silent — the event
+        #: already fired). The eager-delivery seam for single-owner
+        #: stacks (node.py): "a round-certificate quorum just formed"
+        #: is exactly this edge. The simulator's verifier is shared
+        #: across processes, so it wires eagerness through the
+        #: Process.on_deliver_early seam instead.
+        self.on_certified: Optional[Callable[[RoundCertificate], None]] = None
         self.stats = {
             "certs_checked": 0,
             "certs_valid": 0,
@@ -178,6 +186,8 @@ class CertVerifier:
             self._verdicts.clear()
         self._verdicts[key] = ok
         self.stats["certs_valid" if ok else "certs_invalid"] += 1
+        if ok and self.on_certified is not None:
+            self.on_certified(cert)
         return ok
 
     def _pairing_check(self, pairs: Sequence[tuple]) -> bool:
@@ -264,6 +274,8 @@ class CertVerifier:
                     self.stats["certs_checked"] += 1
                     self.stats["certs_valid"] += 1
                     verdicts[i] = True
+                    if self.on_certified is not None:
+                        self.on_certified(certs[i])
                 return [bool(v) for v in verdicts]
         # a structural defect or a failed combined product: localize with
         # individual (memoized) checks — identical verdicts to the oracle

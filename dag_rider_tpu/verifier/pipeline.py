@@ -262,6 +262,7 @@ class VerifierPipeline(Verifier):
         self,
         vertices: Sequence[Vertex],
         overlap: Optional[Callable[[], None]] = None,
+        hold_tail: bool = False,
     ) -> List[bool]:
         """One coalesced cycle: chunk ``vertices`` at the verifier's
         fixed bucket, stream the chunks through the depth-K window, run
@@ -273,7 +274,18 @@ class VerifierPipeline(Verifier):
         boundaries, so padding — and therefore the mask — is
         byte-identical to the serial path. ``seam_s``/``last_seam_s``
         exclude the overlap callback's duration (the callee accounts for
-        its own time)."""
+        its own time).
+
+        ``hold_tail`` (ISSUE 16 tentpole 4) keeps up to ``depth - 1``
+        chunks in flight across the call boundary instead of draining
+        the window at the cycle edge: the returned mask then covers only
+        the RESOLVED front of this call's input, and the held chunks'
+        masks emerge at the FRONT of the next call's mask (or via
+        :meth:`drain`), in the same FIFO order. Callers owning the
+        round loop (the simulator's pipelined path) use it so the
+        device keeps crunching round r+1's tail while the host pumps
+        round r+2 — the depth-K window spans round boundaries rather
+        than re-filling from empty each cycle."""
         t0 = time.perf_counter()
         self.last_wait_s = 0.0
         self.last_max_depth = len(self._inflight)
@@ -338,7 +350,8 @@ class VerifierPipeline(Verifier):
             t1 = time.perf_counter()
             overlap()
             overlap_s = time.perf_counter() - t1
-        while self._pending():
+        keep = max(0, depth - 1) if hold_tail else 0
+        while self._pending() > keep:
             mask.extend(self._resolve_oldest())
         self.last_seam_s = max(0.0, (time.perf_counter() - t0) - overlap_s)
         self.seam_s += self.last_seam_s

@@ -187,21 +187,60 @@ def test_batcher_size_and_deadline_triggers():
     assert b2.build(0.06) is not None
 
 
-def test_batcher_drain_partial_once_and_limit():
+def test_batcher_drain_rearms_deadline_and_limit():
     cfg, pool, b = _packed(
         txs=[bytes([i]) * 32 for i in range(9)]
     )  # 288 bytes = 4 full blocks + 1 straggler
     out = b.drain(99.0)  # deadline long past
-    # 4 size-triggered blocks; the straggler waits for the NEXT deadline
-    # (one partial per call, and the first block already used the fire)
-    assert len(out) == 4 and len(pool) == 1
-    assert len(b.drain(99.0)) == 1  # next cycle: the deadline partial
-    assert len(pool) == 0
+    # 4 size-triggered blocks AND the overdue straggler in the same
+    # call: the deadline trigger re-arms against the remaining pool
+    # (ISSUE 16 satellite — the old size-only re-check stranded aged
+    # traffic for a full extra drain cycle)
+    assert len(out) == 5 and len(pool) == 0
+    assert b.drain(99.0) == []  # nothing left
     cfg2, pool2, b2 = _packed(txs=[bytes([i]) * 32 for i in range(8)])
     assert len(b2.drain(99.0, limit=2)) == 2
     assert len(pool2) == 4  # the rest stays pooled
     assert len(b2.drain(0.0, force=True)) == 2
     assert 0.9 <= b2.mean_fill() <= 1.0
+
+
+def test_batcher_drain_young_tail_stays_pooled():
+    """The re-armed deadline is still a deadline: once the remaining
+    pool holds only YOUNG under-size traffic, the drain stops — no run
+    of near-empty blocks from a deep-but-fresh pool."""
+    cfg = MempoolConfig(cap=64, batch_bytes=64, batch_deadline_ms=50.0)
+    pool = TransactionPool(cfg)
+    # two lanes aged past the deadline, one fresh lane
+    pool.add(b"a" * 8, "old0", 0.0)
+    pool.add(b"b" * 8, "old1", 0.0)
+    pool.add(b"c" * 8, "fresh", 0.10)
+    b = BlockBatcher(cfg, pool)
+    out = b.drain(0.06)  # 60ms: lanes old0/old1 overdue, fresh is 0ms old
+    # round-robin packing folds every overdue lane's traffic into the
+    # first partial; the fresh lane's tx rides along in the same block
+    # (it was pooled when the trigger fired) — the point is the drain
+    # neither stalls overdue lanes NOR keeps building once the pool
+    # holds only young traffic
+    assert out and len(pool) == 0
+    pool.add(b"d" * 8, "fresh2", 0.07)
+    assert b.drain(0.08) == []  # 10ms old, under size: no trigger
+
+
+def test_batcher_multiple_aged_lanes_one_drain():
+    """Regression (ISSUE 16 satellite): several client lanes each
+    independently aged past batch_deadline_ms with a per-block tx cap
+    forcing multiple partial builds — ALL overdue traffic ships in one
+    drain call instead of one lane per cycle."""
+    cfg = MempoolConfig(
+        cap=64, batch_bytes=4096, batch_deadline_ms=50.0, max_batch_txs=1
+    )
+    pool = TransactionPool(cfg)
+    for i in range(3):
+        pool.add(bytes([i]) * 8, f"lane{i}", 0.0)
+    b = BlockBatcher(cfg, pool)
+    out = b.drain(0.10)  # all three lanes 100ms old, all under size
+    assert len(out) == 3 and len(pool) == 0
 
 
 # -- histogram --------------------------------------------------------------

@@ -261,3 +261,58 @@ def test_sim_commit_order_matches_cpu_at_every_depth(keys):
         snap = sim.processes[0].metrics.snapshot()
         assert "verify_overlap_fraction" in snap
         assert "verify_queue_depth_p50" in snap
+
+
+@pytest.mark.parametrize("depth", [2, 4])
+def test_hold_tail_masks_fifo_across_calls(keys, depth):
+    """ISSUE 16 tentpole 4: ``run_coalesced(..., hold_tail=True)`` may
+    keep up to depth-1 chunks in flight ACROSS the call boundary — the
+    cross-round verify window. Held results must emerge at the FRONT of
+    a later call's mask (FIFO), ``drain()`` settles the remainder, and
+    the concatenated stream is byte-identical to the CPU oracle."""
+    reg, _ = keys
+    cpu = CPUVerifier(reg)
+    rng = random.Random(900 + depth)
+    pool = _signed_pool(keys, 72, seed=900 + depth)
+    want = cpu.verify_batch(pool)
+    assert not all(want), "no corruption landed"
+
+    pipe = VerifierPipeline(
+        TPUVerifier(reg), depth=depth, fixed_bucket=8, warmup=False
+    )
+    got, held_once, i = [], False, 0
+    while i < len(pool):
+        k = rng.randint(1, 24)
+        burst = pool[i : i + k]
+        i += k
+        mask = pipe.run_coalesced(burst, hold_tail=True)
+        # held chunks can flush ahead of this burst's own results, but
+        # never more than the window could have been holding
+        assert len(mask) <= len(burst) + (depth - 1) * 8
+        if len(mask) < len(burst):
+            held_once = True
+        got.extend(mask)
+    got.extend(pipe.drain())
+    assert held_once, "the window never held a tail across a call"
+    assert got == want
+    # a drained pipeline owes nothing more
+    assert pipe.drain() == []
+
+
+def test_hold_tail_depth_one_never_holds(keys):
+    """depth=1 degenerates hold_tail to the synchronous path: every
+    call settles its own burst in full."""
+    reg, _ = keys
+    pool = _signed_pool(keys, 24, seed=11)
+    cpu = CPUVerifier(reg)
+    pipe = VerifierPipeline(
+        TPUVerifier(reg), depth=1, fixed_bucket=8, warmup=False
+    )
+    got = []
+    for i in range(0, len(pool), 7):
+        burst = pool[i : i + 7]
+        mask = pipe.run_coalesced(burst, hold_tail=True)
+        assert len(mask) == len(burst)
+        got.extend(mask)
+    assert got == cpu.verify_batch(pool)
+    assert pipe.drain() == []
