@@ -958,6 +958,288 @@ def _finality_rung(
     return entry
 
 
+def _lanes_ab_rung(
+    n: int = 64,
+    sizes: tuple = (131072, 524288, 2097152),
+    cycles: int = 6,
+    sweep: tuple = (1, 2, 4),
+) -> dict:
+    """ladder.lanes rung (ISSUE 17): sharded dissemination lanes —
+    digest-only ordering with parallel payload workers — in two halves.
+
+    Half 1 — the byte-identity gate: lanes-on vs inline lockstep sims
+    over a seeded n × adversary × pump matrix must produce the same
+    per-view commit order (round, source) AND the same delivered
+    payload bytes (sha256 over the length-prefixed transaction stream,
+    post lane-store resolution). RAISES AssertionError on any
+    divergence — a recorded entry IS a passed gate.
+
+    Half 2 — the throughput headline at ``n`` with Ed25519-signed
+    vertices (verifier="cpu" — the keyless sim passes vertex objects by
+    reference, so inline dissemination there is literally free and an
+    A/B against it would be meaningless): committed payload bytes per
+    second of ordering-path (pump) time, lanes vs inline, as block
+    weight grows 16x. The pump is the metric because it is the claim —
+    lanes exist to keep payload weight OFF the consensus critical path;
+    signature verification is already coalesced/offloaded outside the
+    pump window on both sides. Each burst is submitted and (lanes side)
+    flushed before pumping — steady-state pipelining, where worker
+    lanes disseminate a burst while ordering runs. ``throughput_2x``
+    records the >=2x acceptance gate at 4 workers and the top block
+    size; ``pump_flat_1p3x`` records lanes' host_pump_ms_per_round
+    staying within 1.3x across the 16x size growth (inline's grows with
+    block weight — that gap IS the win). A worker sweep at the top size
+    rides alongside."""
+    import hashlib
+    import time as _t
+
+    from dag_rider_tpu.config import Config
+    from dag_rider_tpu.consensus.adversary import (
+        ByzantineProcess,
+        make_behavior,
+    )
+    from dag_rider_tpu.consensus.process import Process
+    from dag_rider_tpu.consensus.simulator import Simulation
+    from dag_rider_tpu.core.types import Block
+
+    # -- half 1: identity gate over the seeded matrix ----------------------
+
+    def identity_side(sz, seed, adversary, pump, lanes, id_cycles):
+        cfg = Config(
+            n=sz,
+            coin="round_robin",
+            propose_empty=True,
+            pump=pump,
+            lanes=lanes,
+            lane_batch_bytes=256,
+            sync_request_cooldown_s=0.0,
+            sync_serve_cooldown_s=0.0,
+            sync_patience=1,
+        )
+        nbyz = cfg.f if adversary else 0
+        behaviors = {
+            i: make_behavior(adversary, seed=seed + 1000 + i)
+            for i in range(nbyz)
+        }
+
+        def factory(pcfg, i, ptp, **kwargs):
+            if i in behaviors:
+                return ByzantineProcess(
+                    pcfg, i, ptp, behavior=behaviors[i], **kwargs
+                )
+            return Process(pcfg, i, ptp, **kwargs)
+
+        sim = Simulation(
+            cfg, process_factory=factory if behaviors else None
+        )
+        sim.submit_blocks(2, tx_bytes=600)  # above the 256-byte floor
+        for _ in range(id_cycles):
+            sim.run(max_messages=sz * (sz - 1))
+        orders, digests = [], []
+        for view in sim.deliveries[nbyz:]:
+            orders.append([(v.id.round, v.id.source) for v in view])
+            h = hashlib.sha256()
+            for v in view:
+                for tx in v.block.transactions:
+                    h.update(len(tx).to_bytes(4, "little"))
+                    h.update(tx)
+            digests.append(h.hexdigest())
+        return orders, digests, sim, nbyz
+
+    id_matrix = (
+        (4, 21, None, 12),
+        (16, 22, "equivocate", 12),
+        (16, 23, "lane_withhold", 12),
+        (32, 24, None, 10),
+    )
+    identity = []
+    for sz, seed, adversary, id_cycles in id_matrix:
+        for pump in ("scalar", "vector"):
+            ref_o, ref_d, _, nbyz = identity_side(
+                sz, seed, adversary, pump, False, id_cycles
+            )
+            lane_o, lane_d, sim, _ = identity_side(
+                sz, seed, adversary, pump, True, id_cycles
+            )
+            if not any(ref_o):
+                raise AssertionError(
+                    f"lanes identity n={sz} {adversary} {pump}: oracle "
+                    "delivered nothing — vacuous gate"
+                )
+            if ref_o != lane_o:
+                raise AssertionError(
+                    f"lanes identity n={sz} {adversary} {pump}: commit "
+                    "order diverged from the inline oracle"
+                )
+            if ref_d != lane_d:
+                raise AssertionError(
+                    f"lanes identity n={sz} {adversary} {pump}: "
+                    "delivered payload bytes diverged from the oracle"
+                )
+            certified = sum(
+                p.metrics.counters.get("lane_batches_certified", 0)
+                for p in sim.processes
+            )
+            if adversary != "lane_withhold" and not certified:
+                raise AssertionError(
+                    f"lanes identity n={sz} {adversary} {pump}: no "
+                    "batch ever certified — blocks shipped inline, "
+                    "vacuous gate"
+                )
+            identity.append(
+                {
+                    "n": sz,
+                    "seed": seed,
+                    "adversary": adversary or "clean",
+                    "pump": pump,
+                    "delivered_view0": len(ref_o[0]),
+                    "lane_batches_certified": certified,
+                }
+            )
+
+    # -- half 2: committed-bytes/s per pump-second at n --------------------
+
+    def tput_side(size, lanes, workers):
+        import gc
+
+        # drain the previous side's multi-hundred-MB object graph before
+        # timing this one — a generational collection landing mid-pump
+        # charges the victim side a triple-digit-ms pause it didn't earn
+        gc.collect()
+        cfg = Config(
+            n=n, lanes=lanes, lane_workers=workers, lane_batch_bytes=4096
+        )
+        sim = Simulation(cfg, verifier="cpu")
+        p0 = sim.processes[0]
+        acc = {"bytes": 0, "txs": 0}
+
+        def on_dlv(v, acc=acc):
+            for tx in v.block.transactions:
+                acc["bytes"] += len(tx)
+                acc["txs"] += 1
+
+        p0.on_deliver = on_dlv
+        # borrow the collector off for the timed box (restored in
+        # finally): both sides get the same allocator behavior and no
+        # side eats a mid-pump generational pause
+        gc_was = gc.isenabled()
+        gc.disable()
+        try:
+            t0 = _t.perf_counter()
+            for c in range(cycles):
+                for p in sim.processes:
+                    p.submit(
+                        Block(
+                            (
+                                f"c{c}-p{p.index}".encode().ljust(
+                                    size, b"."
+                                ),
+                            )
+                        )
+                    )
+                if lanes and sim.lane_bus is not None:
+                    # steady-state pipelining: the worker lanes finish
+                    # disseminating the burst before ordering pumps it
+                    # (in sustained operation this overlaps the previous
+                    # burst's ordering)
+                    sim.lane_bus.flush()
+                sim.run(max_messages=2 * n * n)
+            sim.run(max_messages=4 * n * n)
+            wall = _t.perf_counter() - t0
+        finally:
+            if gc_was:
+                gc.enable()
+        m = p0.metrics
+        # land delivered bytes in the metrics seam so the snapshot
+        # derives the committed_bytes_per_s gauge (the same path a
+        # mempool-fronted node exercises)
+        m.observe_mempool({"delivered_bytes": acc["bytes"]})
+        snap = m.snapshot()
+        return {
+            "delivered_txs": acc["txs"],
+            "delivered_bytes": acc["bytes"],
+            "wall_s": round(wall, 2),
+            "host_pump_ms_per_round": snap.get(
+                "host_pump_ms_per_round"
+            ),
+            "committed_bytes_per_s": snap.get("committed_bytes_per_s"),
+        }
+
+    def best_of(runs, size, lanes, workers):
+        # best-of-k by pump floor: the box this runs on shares its core,
+        # and a neighbor's burst landing mid-pump inflates one run's
+        # floor by triple-digit ms; the minimum is the reproducible cost
+        best = None
+        for _ in range(runs):
+            side = tput_side(size, lanes, workers)
+            if (
+                best is None
+                or side["host_pump_ms_per_round"]
+                < best["host_pump_ms_per_round"]
+            ):
+                best = side
+        return best
+
+    ab = []
+    for size in sizes:
+        inline = best_of(2, size, False, 4)
+        laned = best_of(2, size, True, 4)
+        if inline["delivered_txs"] != laned["delivered_txs"]:
+            raise AssertionError(
+                f"lanes A/B size={size}: delivered tx counts diverged "
+                f"({inline['delivered_txs']} vs {laned['delivered_txs']})"
+            )
+        ratio = (
+            laned["committed_bytes_per_s"]
+            / inline["committed_bytes_per_s"]
+            if inline["committed_bytes_per_s"]
+            else 0.0
+        )
+        ab.append(
+            {
+                "block_bytes": size,
+                "inline": inline,
+                "lanes": laned,
+                "committed_bytes_ratio": round(ratio, 2),
+            }
+        )
+
+    workers_sweep = []
+    for w in sweep:
+        side = tput_side(sizes[-1], True, w)
+        workers_sweep.append(
+            {
+                "workers": w,
+                "wall_s": side["wall_s"],
+                "host_pump_ms_per_round": side["host_pump_ms_per_round"],
+                "committed_bytes_per_s": side["committed_bytes_per_s"],
+            }
+        )
+
+    lane_pumps = [e["lanes"]["host_pump_ms_per_round"] for e in ab]
+    flatness = (
+        max(lane_pumps) / min(lane_pumps) if min(lane_pumps) else 0.0
+    )
+    top = ab[-1]
+    return {
+        "nodes": n,
+        "block_bytes": list(sizes),
+        "cycles": cycles,
+        "verifier": "cpu",
+        "identity": identity,
+        # half 1 raises on divergence, so reaching here means both
+        # gates held across the whole matrix
+        "commit_order_identical": True,
+        "delivered_bytes_identical": True,
+        "ab": ab,
+        "workers_sweep": workers_sweep,
+        "committed_bytes_ratio_top": top["committed_bytes_ratio"],
+        "throughput_2x": top["committed_bytes_ratio"] >= 2.0,
+        "lane_pump_flatness": round(flatness, 2),
+        "pump_flat_1p3x": bool(flatness and flatness <= 1.3),
+    }
+
+
 def _agg_ladder_rung(sizes=(64, 256)) -> dict:
     """verify_n256_agg ladder rung (round 13): component costs of the
     aggregated round-certificate check at committee quorums vs the
@@ -2237,6 +2519,58 @@ def _measure() -> None:
             _mark(f"ladder finality FAILED: {e!r}")
     else:
         _mark(f"skipping ladder finality (left {left():.0f}s)")
+
+    # -- ladder rung (ISSUE 17): sharded dissemination lanes. Half 1 is
+    # the byte-identity gate (commit order AND delivered payload bytes,
+    # lanes vs inline, over a seeded n × adversary × pump matrix — the
+    # rung RAISES on divergence); half 2 is the committed-bytes-per-
+    # pump-second A/B at n=64 with Ed25519-signed vertices as block
+    # weight grows 16x, plus a lane-worker sweep at the top size.
+    lanes_s = float(os.environ.get("DAGRIDER_BENCH_LANES_S", "15"))
+    lanes_n = int(os.environ.get("DAGRIDER_BENCH_LANES_N", "64"))
+    if lanes_s > 0 and left() > 150:
+        _mark(f"ladder lanes: n={lanes_n}, identity matrix + A/B sweep")
+        try:
+            t_rung = time.monotonic()
+            entry = _lanes_ab_rung(n=lanes_n)
+            entry["rung_seconds"] = round(time.monotonic() - t_rung, 1)
+            result["ladder"]["lanes"] = entry
+            _mark(
+                f"ladder lanes: identity gate held over "
+                f"{len(entry['identity'])} matrix cases, "
+                f"committed-bytes ratio "
+                f"{entry['committed_bytes_ratio_top']}x at top size "
+                f"({'OK' if entry['throughput_2x'] else 'MISSED'}), "
+                f"lane pump flatness {entry['lane_pump_flatness']}x "
+                f"({'OK' if entry['pump_flat_1p3x'] else 'MISSED'})"
+            )
+            emit()
+            import datetime as _dt
+
+            from dag_rider_tpu import config as _cfg
+
+            out_path = os.path.join(
+                _REPO, _cfg.env_str("DAGRIDER_LANES_OUT")
+            )
+            with open(out_path, "w") as fh:
+                json.dump(
+                    {
+                        "schema": "dag-rider-tpu/bench-lanes/v1",
+                        "captured": _dt.datetime.now().isoformat(
+                            timespec="seconds"
+                        ),
+                        "backend": result.get("backend", "cpu"),
+                        "lanes": entry,
+                    },
+                    fh,
+                    indent=1,
+                )
+                fh.write("\n")
+            _mark(f"ladder lanes: wrote {out_path}")
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder lanes FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder lanes (left {left():.0f}s)")
 
     # -- ladder rung: Byzantine adversary x WAN suite at committee scale.
     # Every adversary class from consensus/adversary.py drives f=10 of

@@ -112,6 +112,14 @@ LADDERS: Tuple[Ladder, ...] = (
         _P + "_eager_surface",
         _P + "_order_vertices",
     ),
+    # sharded dissemination lanes vs inline payloads (sub-threshold,
+    # magic-aliasing and under-quorum blocks all fall back to inline)
+    Ladder(
+        "DAGRIDER_LANES",
+        _P + "submit",
+        _P + "_submit_via_lanes",
+        _P + "_submit_inline",
+    ),
 )
 
 

@@ -143,6 +143,16 @@ _register("DAGRIDER_EAGER_DELIVER", "flag", False,
           "on_deliver_early ahead of the deferred canonical flush")
 _register("DAGRIDER_FINALITY_OUT", "str", "BENCH_r08.json",
           "finality-ladder bench output path")
+_register("DAGRIDER_LANES", "flag", False,
+          "sharded dissemination lanes: vertices carry certified batch "
+          "digests while worker lanes move the payload bytes (ISSUE 17)")
+_register("DAGRIDER_LANE_WORKERS", "int", 4,
+          "payload-dissemination worker threads per lane bus", minimum=1)
+_register("DAGRIDER_LANE_BATCH_BYTES", "int", 1024,
+          "minimum encoded block size worth a lane round-trip; smaller "
+          "blocks ship inline (the oracle path)", minimum=1)
+_register("DAGRIDER_LANES_OUT", "str", "BENCH_r09.json",
+          "lanes-ladder bench output path")
 
 
 def _raw(name: str) -> str:
@@ -356,6 +366,20 @@ class Config:
     # mismatch is an invariant violation routed through the flight
     # recorder. None resolves from DAGRIDER_EAGER_DELIVER.
     eager_deliver: Optional[bool] = None
+    # Sharded dissemination lanes (ISSUE 17): when on, each submitted
+    # block whose encoding reaches lane_batch_bytes is disseminated over
+    # the dedicated lane channel by worker threads, certified by 2f+1
+    # signed availability acks, and proposed as a constant-size digest
+    # ref; the consensus pump orders refs, delivery resolves them back
+    # to payload bytes through the lane store (fetch-on-miss). Off keeps
+    # inline payloads — the byte-identity oracle. None resolves from
+    # DAGRIDER_LANES; explicit beats env, like pump/cert.
+    lanes: Optional[bool] = None
+    #: lane worker-thread count (None -> DAGRIDER_LANE_WORKERS)
+    lane_workers: Optional[int] = None
+    #: minimum encoded-block bytes before a block rides a lane
+    #: (None -> DAGRIDER_LANE_BATCH_BYTES); smaller blocks stay inline
+    lane_batch_bytes: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -393,6 +417,26 @@ class Config:
         if self.eager_deliver is None:
             object.__setattr__(
                 self, "eager_deliver", env_flag("DAGRIDER_EAGER_DELIVER")
+            )
+        if self.lanes is None:
+            object.__setattr__(self, "lanes", env_flag("DAGRIDER_LANES"))
+        if self.lane_workers is None:
+            object.__setattr__(
+                self, "lane_workers", env_int("DAGRIDER_LANE_WORKERS")
+            )
+        if self.lane_workers < 1:
+            raise ValueError(
+                f"lane_workers must be >= 1, got {self.lane_workers}"
+            )
+        if self.lane_batch_bytes is None:
+            object.__setattr__(
+                self,
+                "lane_batch_bytes",
+                env_int("DAGRIDER_LANE_BATCH_BYTES"),
+            )
+        if self.lane_batch_bytes < 1:
+            raise ValueError(
+                f"lane_batch_bytes must be >= 1, got {self.lane_batch_bytes}"
             )
         if self.f is None:
             object.__setattr__(self, "f", (self.n - 1) // 3)

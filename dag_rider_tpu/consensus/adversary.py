@@ -50,6 +50,8 @@ ADVERSARIES = (
     "withhold",
     "invalid_edges",
     "garbage_coin",
+    "lane_withhold",
+    "lane_garbage_ack",
 )
 
 
@@ -88,6 +90,13 @@ class ByzantineBehavior:
         """Hook run once after the host process is fully constructed —
         strategies that corrupt state *creation* (not just the wire)
         install themselves here. Default: nothing."""
+
+    def bind_lanes(self, proc: Process) -> None:
+        """Hook run when a dissemination-lane coordinator is attached
+        (ISSUE 17) — lanes are wired post-construction, after
+        :meth:`bind` has already run, so lane strategies install here.
+        Default: nothing (a lanes-off run leaves lane adversaries
+        honest, and their stats prove vacuity)."""
 
     def disseminate(self, proc: Process, v: Vertex) -> None:
         proc.transport.broadcast(self._msg(v))
@@ -263,6 +272,66 @@ class GarbageCoinBehavior(ByzantineBehavior):
         return bls.g1_compress(pt)
 
 
+class LaneWithholdBehavior(ByzantineBehavior):
+    """Payload withholding at the lane layer (ISSUE 17): vertices and
+    lane *refs* flow honestly, but each lane batch is withheld from a
+    seeded victim subset. A victim admits and orders the carrier vertex
+    normally (ordering is payload-blind — that's the point of lanes)
+    and only discovers the hole at delivery resolution, where
+    fetch-on-miss must recover the bytes from a certified holder. If
+    the victim set is large enough to starve the 2f+1 ack quorum, the
+    producer's own materialize degrades the block to the inline oracle
+    instead — zero loss either way."""
+
+    name = "lane_withhold"
+
+    def bind_lanes(self, proc: Process) -> None:
+        coord = proc.lanes
+        if coord is None:
+            return
+        endpoint = coord.endpoint
+        dests = [i for i in range(proc.cfg.n) if i != proc.index]
+
+        def withholding(digest: bytes, payload: bytes) -> int:
+            k = self.rng.randrange(1, max(2, len(dests)))
+            victims = set(self.rng.sample(dests, k))
+            sent = 0
+            for d in dests:
+                if d in victims:
+                    self.stats["withheld"] += 1
+                else:
+                    endpoint.send(d, "batch", (digest, payload))
+                    sent += 1
+            return sent
+
+        coord._broadcast_batch = withholding  # instance attr shadows
+
+
+class LaneGarbageAckBehavior(ByzantineBehavior):
+    """Garbage availability acks (ISSUE 17): this process receives lane
+    batches honestly (it must — an f-bounded adversary can't fake what
+    it serves on fetch) but answers every one with a corrupted ack —
+    wrong digest echo plus junk signature bytes. Producers key ack
+    collection by echoed digest and structurally filter shares, so the
+    garbage never enters a certificate; at n = 3f+1 the remaining
+    self + (n-1-f) honest acks are exactly the 2f+1 quorum, so honest
+    producers still certify every batch."""
+
+    name = "lane_garbage_ack"
+
+    def bind_lanes(self, proc: Process) -> None:
+        coord = proc.lanes
+        if coord is None:
+            return
+
+        def garbled(digest: bytes):
+            self.stats["mutated"] += 1
+            bad_digest = bytes(b ^ 0xFF for b in digest)
+            return bad_digest, self.rng.randbytes(48)
+
+        coord._make_ack = garbled  # instance attr shadows the method
+
+
 def make_behavior(kind: str, seed: int = 0) -> ByzantineBehavior:
     """Factory over :data:`ADVERSARIES` (scenario runner / bench rung)."""
     if kind == "equivocate":
@@ -275,6 +344,10 @@ def make_behavior(kind: str, seed: int = 0) -> ByzantineBehavior:
         return InvalidEdgesBehavior(seed)
     if kind == "garbage_coin":
         return GarbageCoinBehavior(seed)
+    if kind == "lane_withhold":
+        return LaneWithholdBehavior(seed)
+    if kind == "lane_garbage_ack":
+        return LaneGarbageAckBehavior(seed)
     raise ValueError(f"unknown adversary {kind!r} (choose from {ADVERSARIES})")
 
 
@@ -306,3 +379,9 @@ class ByzantineProcess(Process):
 
     def _broadcast_vertex(self, v: Vertex) -> None:
         self.behavior.disseminate(self, v)
+
+    def attach_lanes(self, coordinator) -> None:
+        # lanes are wired after __init__ (simulator post-construction
+        # pass), so lane behaviors get their own bind point here
+        super().attach_lanes(coordinator)
+        self.behavior.bind_lanes(self)

@@ -137,7 +137,16 @@ class Process:
         self._pending_verify: List[Vertex] = []
         self._pending_verify_ids: Set[VertexID] = set()
         self._waves_tried: Set[int] = set()
+        #: entries are payload Blocks — or, when dissemination lanes are
+        #: attached, LanePending handles whose in-flight publish
+        #: materializes into a certified carrier block at proposal time
+        #: (ISSUE 17); handles expose ``transactions`` so queue readers
+        #: (checkpoint, audits, depth backpressure) need not care
         self.blocks_to_propose: Deque[Block] = deque()
+        #: dissemination-lane coordinator, wired post-construction via
+        #: attach_lanes when cfg.lanes is on (None = inline payloads,
+        #: the byte-identity oracle)
+        self.lanes = None
         self.decided_wave = 0
         self._pending_waves: Set[int] = set()
         self.delivered_log: List[VertexID] = []
@@ -354,10 +363,43 @@ class Process:
         """Enqueue a client block for proposal — the missing writer of the
         reference's ``blocksToPropose`` (D7, ``process.go:80``) — and kick
         the state machine: with ``propose_empty=False`` a quiescent cluster
-        must be able to resume on submission alone."""
+        must be able to resume on submission alone.
+
+        With dissemination lanes attached the block's payload starts its
+        lane round-trip here, so the dissemination overlaps the
+        submit→propose gap; the inline enqueue is the oracle (and the
+        degradation target for any block a lane cannot certify)."""
+        if self.lanes is not None:
+            self._submit_via_lanes(block)
+        else:
+            self._submit_inline(block)
+
+    def _submit_inline(self, block: Block) -> None:
+        """The oracle path: the payload block itself rides the vertex."""
         self.blocks_to_propose.append(block)
         if self._started:
             self.step()
+
+    def _submit_via_lanes(self, block: Block) -> None:
+        """Lane path (ISSUE 17): start the payload publish on the lane
+        workers and queue the pending handle in the block's submission
+        slot — proposal-time materialization keeps the carrier in
+        exactly the round the inline block would have taken, which is
+        what makes lanes-vs-inline byte-identity provable. Blocks the
+        lane refuses (undersized, magic-aliasing) ship inline."""
+        pending = self.lanes.begin_publish(block)
+        if pending is None:
+            self._submit_inline(block)
+            return
+        self.blocks_to_propose.append(pending)
+        if self._started:
+            self.step()
+
+    def attach_lanes(self, coordinator) -> None:
+        """Wire a LaneCoordinator (post-construction, like the eager
+        sink): subsequent submits disseminate payloads via lanes and
+        deliveries resolve carrier refs back to payload bytes."""
+        self.lanes = coordinator
 
     def start(self) -> None:
         """Begin participating: advance from the genesis round."""
@@ -1536,6 +1578,11 @@ class Process:
             if self.blocks_to_propose
             else Block()
         )
+        if self.lanes is not None:
+            # a LanePending handle becomes its certified carrier block
+            # (or the payload itself on degrade); plain blocks pass
+            # through untouched
+            block = self.lanes.materialize(block)
         # u.id IS VertexID(rnd-1, u.source) — reuse instead of
         # re-constructing n ids per proposal (a top allocation site of
         # the n=256 host profile)
@@ -2107,6 +2154,7 @@ class Process:
         base = self.dag.base_round
         gc = self.cfg.gc_depth
         cb = self.on_deliver_early
+        lanes = self.lanes
         by_round = self.dag._round_vertices
         count = 0
         for leader in chain:
@@ -2132,6 +2180,8 @@ class Process:
                 v = d[src]
                 self.eager_log.append(v.id)
                 if cb is not None:
+                    if lanes is not None:
+                        v = lanes.resolve_vertex(v)
                     cb(v)
             count += int(rrs.size)
         if count:
@@ -2412,6 +2462,7 @@ class Process:
                     by_round = self.dag._round_vertices
                     log_append = self.delivered_log.append
                     cb = self.on_deliver
+                    lanes = self.lanes
                     # per-round source dict fetched once per run of
                     # consecutive slots (nonzero is round-major), and
                     # the existing v.id is reused — constructing a
@@ -2426,6 +2477,11 @@ class Process:
                         v = d[src]
                         log_append(v.id)
                         if cb is not None:
+                            if lanes is not None:
+                                # carrier refs surface as payload bytes
+                                # (fetch-on-miss inside); the id the log
+                                # keeps is unchanged
+                                v = lanes.resolve_vertex(v)
                             cb(v)
                         if (
                             trace
@@ -2446,7 +2502,10 @@ class Process:
                 self.delivered_log.append(vid)
                 self.metrics.inc("vertices_delivered")
                 if self.on_deliver is not None:
-                    self.on_deliver(self.dag.vertices[vid])
+                    v = self.dag.vertices[vid]
+                    if self.lanes is not None:
+                        v = self.lanes.resolve_vertex(v)
+                    self.on_deliver(v)
                 if trace and vid.source == self.index:
                     v = self.dag.vertices[vid]
                     if v.block.transactions:

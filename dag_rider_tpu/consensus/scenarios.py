@@ -87,6 +87,12 @@ class Scenario:
     min_waves: int = 2
     min_each: int = 1
     blocks_per_process: int = 3
+    #: dissemination lanes (ISSUE 17). None resolves to forced-on for the
+    #: lane_* adversaries (their attack surface IS the lane layer) and
+    #: otherwise defers to the DAGRIDER_LANES env default — under which
+    #: the stock 32-byte scenario blocks sit below the lane batch floor
+    #: and ship inline, so the legacy matrix is byte-identical either way.
+    lanes: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.adversary is not None and self.adversary not in ADVERSARIES:
@@ -118,6 +124,11 @@ class Scenario:
             if self.adversary == "garbage_coin"
             else "round_robin"
         )
+
+    def resolved_lanes(self) -> bool:
+        if self.lanes is not None:
+            return self.lanes
+        return self.adversary in ("lane_withhold", "lane_garbage_ack")
 
     def resolved_rbc(self) -> bool:
         if self.rbc is not None:
@@ -205,6 +216,10 @@ def run_scenario(sc: Scenario) -> dict:
     cfg = Config(
         n=sc.n,
         propose_empty=True,
+        # None defers to the DAGRIDER_LANES env default (tier1-lanes CI
+        # runs the whole legacy matrix with lanes on; 32-byte blocks
+        # stay inline there by the batch-size floor)
+        lanes=True if sc.resolved_lanes() else None,
         # virtual-time lockstep: wall-clock flood control off
         sync_request_cooldown_s=0.0,
         sync_serve_cooldown_s=0.0,
@@ -243,11 +258,24 @@ def run_scenario(sc: Scenario) -> dict:
 
     honest = [i for i in range(cfg.n) if i not in set(byz)]
     accepted: set = set()
+    # Lane scenarios pad past the batch floor so every block actually
+    # takes the lane path; everything else keeps the 32-byte legacy shape.
+    pad = 2 * cfg.lane_batch_bytes if sc.resolved_lanes() else 32
     for i in honest:
         for k in range(sc.blocks_per_process):
-            tx = f"s{sc.seed}-p{i}-b{k}".encode().ljust(32, b".")
+            tx = f"s{sc.seed}-p{i}-b{k}".encode().ljust(pad, b".")
             accepted.add(tx)
             sim.processes[i].submit(Block((tx,)))
+    if sc.resolved_lanes():
+        # Byzantine lane workers only misbehave on their OWN publishes
+        # (withhold their own batches / garble their acks), so feed them
+        # blocks too. Excluded from `accepted`: zero-loss is an
+        # honest-input property; recovery of Byzantine payloads is what
+        # fetch-on-miss at honest delivery proves.
+        for i in byz:
+            for k in range(sc.blocks_per_process):
+                tx = f"s{sc.seed}-byz{i}-b{k}".encode().ljust(pad, b"!")
+                sim.processes[i].submit(Block((tx,)))
 
     # Per-cycle pump budget: ~a round's worth of deliveries. Bracha
     # multiplies every VAL by ~2n (echo + ready fan-outs), so RBC runs
@@ -288,7 +316,14 @@ def run_scenario(sc: Scenario) -> dict:
             for b in p.blocks_to_propose:
                 retained.update(b.transactions)
             for v in p.dag.vertices.values():
-                retained.update(v.block.transactions)
+                b = v.block
+                if p.lanes is not None:
+                    # undelivered carrier vertices retain their payload
+                    # through the lane store; a local miss (withheld
+                    # batch not yet fetched) falls back to the carrier
+                    # ref — some other honest holder retains the bytes
+                    b = p.lanes.peek_block(b) or b
+                retained.update(b.transactions)
         audit = inv.transaction_audit(
             accepted,
             (
@@ -356,6 +391,11 @@ def run_scenario(sc: Scenario) -> dict:
             getattr(sim.processes[i].coin, "filtered", 0)
             for i in range(cfg.n)
         ),
+        "lanes": bool(cfg.lanes),
+        "lane_batches_certified": _counter("lane_batches_certified"),
+        "lane_fetch_misses": _counter("lane_fetch_misses"),
+        "lane_publish_degraded": _counter("lane_publish_degraded"),
+        "lane_acks_rejected": _counter("lane_acks_rejected"),
         "behavior": behavior_stats,
         "transport": dict(tp.stats),
         "monitor": monitor.stats(),
@@ -384,6 +424,8 @@ def default_matrix(
         mk(adversary="withhold"),
         mk(adversary="invalid_edges"),
         mk(adversary="garbage_coin"),
+        mk(adversary="lane_withhold"),
+        mk(adversary="lane_garbage_ack"),
         mk(adversary="equivocate", wan="regions"),
     ]
 

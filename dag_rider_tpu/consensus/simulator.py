@@ -157,6 +157,30 @@ class Simulation:
             for p, esink in zip(self.processes, self.eager_deliveries):
                 if getattr(p, "on_deliver_early", None) is None:
                     p.on_deliver_early = esink.append
+        # Dissemination lanes (ISSUE 17): one in-memory lane bus for the
+        # cluster, a coordinator per process — wired post-construction
+        # like the eager sinks (attach_lanes is the seam ByzantineProcess
+        # overrides to bind lane behaviors). Keyed deployments reuse the
+        # cert share machinery for signed availability acks; keyless
+        # sims run unsigned.
+        self.lane_bus = None
+        if cfg.lanes:
+            from dag_rider_tpu.lanes import LaneCoordinator
+            from dag_rider_tpu.transport.lanebus import LaneBus
+
+            self.lane_bus = LaneBus(cfg.n, workers=cfg.lane_workers)
+            for i, p in enumerate(self.processes):
+                p.attach_lanes(
+                    LaneCoordinator(
+                        cfg,
+                        i,
+                        self.lane_bus.endpoint(i),
+                        cert_signer=cert_signers[i] if cert_signers else None,
+                        cert_verifier=self.cert_verifier,
+                        metrics=p.metrics,
+                        log=p.log,
+                    )
+                )
         if self.flight is not None:
             # a dump captures every process's full counter state
             for p in self.processes:

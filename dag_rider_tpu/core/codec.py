@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence, Tuple
 from dag_rider_tpu.core.types import (
     Block,
     BroadcastMessage,
+    LaneRef,
     RoundCertificate,
     SpanCertificate,
     Vertex,
@@ -311,6 +312,72 @@ def decode_many(data: bytes, offset: int = 0) -> List[BroadcastMessage]:
             f"trailing bytes after batch: {len(data) - offset}"
         )
     return msgs
+
+
+# -- lane-batch references (ISSUE 17) ---------------------------------------
+
+#: a lane ref is the single pseudo-transaction of its carrier Block;
+#: 8 bytes so no honest client payload shorter than the prefix aliases
+LANE_MAGIC = b"DRlane1\x00"
+
+
+def encode_lane_ref(ref: LaneRef) -> bytes:
+    """Encode a :class:`LaneRef` as a carrier pseudo-transaction.
+
+    Layout after the magic: u32 producer, u32 seq, 32-byte sha256
+    digest, u32 tx count, u32 payload bytes, u32 signer count + u32
+    signers (sorted), u32 agg-sig length + bytes (0 for unsigned)."""
+    out = [
+        LANE_MAGIC,
+        struct.pack("<II", ref.producer, ref.seq),
+        ref.digest,
+        struct.pack("<III", ref.count, ref.nbytes, len(ref.signers)),
+    ]
+    for s in ref.signers:
+        out.append(struct.pack("<I", s))
+    out.append(struct.pack("<I", len(ref.agg_sig)))
+    out.append(ref.agg_sig)
+    return b"".join(out)
+
+
+def decode_lane_ref(tx: bytes) -> Optional[LaneRef]:
+    """Parse a carrier pseudo-transaction; None when ``tx`` is an
+    ordinary client transaction (no magic)."""
+    if not tx.startswith(LANE_MAGIC):
+        return None
+    off = len(LANE_MAGIC)
+    producer, seq = struct.unpack_from("<II", tx, off)
+    off += 8
+    digest = tx[off : off + 32]
+    off += 32
+    count, nbytes, nsig = struct.unpack_from("<III", tx, off)
+    off += 12
+    signers = struct.unpack_from(f"<{nsig}I", tx, off) if nsig else ()
+    off += 4 * nsig
+    (siglen,) = struct.unpack_from("<I", tx, off)
+    off += 4
+    agg = tx[off : off + siglen]
+    if off + siglen != len(tx) or len(digest) != 32:
+        raise ValueError("malformed lane ref")
+    return LaneRef(producer, seq, digest, count, nbytes, tuple(signers), agg)
+
+
+def lane_ref_of(block: Block) -> Optional[LaneRef]:
+    """The ref a carrier block holds, or None for a payload block. A
+    carrier is exactly one magic-prefixed pseudo-transaction — producers
+    refuse to lane any payload whose own transactions alias the magic
+    (see ``LaneCoordinator.begin_publish``), so the shape is unambiguous
+    on the delivery path. A MALFORMED magic-prefixed transaction (only a
+    Byzantine producer can craft one — honest publishes round-trip by
+    construction) is treated as a payload: honest delivery surfaces the
+    garbage bytes as-is, exactly as it would an inline garbage block,
+    instead of crashing the resolve path."""
+    if len(block.transactions) != 1:
+        return None
+    try:
+        return decode_lane_ref(block.transactions[0])
+    except (ValueError, struct.error):
+        return None
 
 
 def frame(payload: bytes) -> bytes:

@@ -85,6 +85,33 @@ class Block:
 
 
 @dataclasses.dataclass(frozen=True)
+class LaneRef:
+    """A certified lane-batch reference (ISSUE 17).
+
+    Stands in for a payload :class:`Block` on the consensus path when
+    dissemination lanes are on: ``digest`` is the sha256 of the encoded
+    payload block, ``signers`` the 2f+1 sources whose availability acks
+    back the batch (sorted), and ``agg_sig`` the compressed G1 sum of
+    their domain-separated BLS ack shares (empty in unsigned
+    deployments — the keyless simulator). ``count``/``nbytes`` restate
+    the payload shape so admission and accounting never need the bytes.
+
+    The ref rides the existing wire unchanged, as the single
+    magic-prefixed pseudo-transaction of a Block (see
+    :func:`dag_rider_tpu.core.codec.encode_lane_ref`) — vertex identity,
+    signing, and the cert path all see an ordinary small block.
+    """
+
+    producer: int
+    seq: int
+    digest: bytes
+    count: int
+    nbytes: int
+    signers: Tuple[int, ...] = ()
+    agg_sig: bytes = b""
+
+
+@dataclasses.dataclass(frozen=True)
 class Vertex:
     """A DAG vertex (reference ``process/process.go:26-31``).
 

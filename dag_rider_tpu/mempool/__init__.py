@@ -103,6 +103,9 @@ class Mempool:
 
         self.latency = Histogram()
         self.delivered_txs = 0
+        #: payload bytes of OUR delivered transactions — the numerator
+        #: of committed-bytes/s in the lanes A/B rung (ISSUE 17)
+        self.delivered_bytes = 0
 
     # -- front door --------------------------------------------------------
 
@@ -256,6 +259,7 @@ class Mempool:
                 if t0 is None:
                     continue
                 self.delivered_txs += 1
+                self.delivered_bytes += len(tx)
                 s = max(0.0, t - t0)
                 self.latency.observe(s)
                 if self.metrics is not None:
@@ -281,6 +285,7 @@ class Mempool:
                 "shed_full": pool.dropped_full,
                 "expired": pool.expired,
                 "delivered_txs": self.delivered_txs,
+                "delivered_bytes": self.delivered_bytes,
                 "blocks_built": self.batcher.blocks_built,
                 "txs_packed": self.batcher.txs_packed,
                 "batch_fill": round(self.batcher.mean_fill(), 4),

@@ -58,6 +58,9 @@ HIGH_FREQ_EVENTS = frozenset(
         "behind_horizon",
         "attested_floor",
         "round_advance",
+        # one per received lane batch — same per-message cadence as
+        # ``admit`` once dissemination lanes are on (ISSUE 17)
+        "lane_batch",
     }
 )
 

@@ -98,6 +98,15 @@ def save(process, path: str, *, mempool=None) -> None:
         },
         "metrics": process.metrics.snapshot(),
     }
+    # Lane state (ISSUE 17): certified batch bytes + sequence cursor. A
+    # crash between certification and delivery must not lose the payload
+    # — the carrier ref in the DAG snapshot only names the digest; the
+    # bytes live in the lane store. Pending (mid-dissemination) blocks
+    # need no lane entry: ``blocks_to_propose`` above serialized their
+    # original transactions, so restore degrades them to the inline
+    # path. Absent in pre-lanes manifests -> lanes restore empty.
+    if getattr(process, "lanes", None) is not None:
+        manifest["lanes"] = process.lanes.checkpoint_state()
     tmp = os.path.join(path, MANIFEST + ".tmp")
     with open(tmp, "w") as fh:
         json.dump(manifest, fh)
@@ -222,6 +231,11 @@ def restore(process, path: str, *, mempool=None) -> None:
             bank[c.round] = c
         span_bank[int(e)] = bank
     process._span_bank = span_bank
+    # Lane store: entries are re-hashed on load (corrupt bytes dropped,
+    # recovered later via fetch-on-miss). Pre-lanes manifests carry no
+    # "lanes" key and restore with an empty store.
+    if getattr(process, "lanes", None) is not None:
+        process.lanes.restore_state(manifest.get("lanes"))
     if mempool is not None:
         mp_path = os.path.join(path, MEMPOOL)
         if os.path.exists(mp_path):

@@ -79,6 +79,15 @@ KNOWN_COUNTERS = frozenset(
         "span_path_enabled",
         "hash_g1_cache_hits",
         "hash_g1_cache_misses",
+        # lanes/ — sharded dissemination (ISSUE 17)
+        "lane_batches_certified",
+        "lane_publish_degraded",
+        "lane_fetch_misses",
+        "lane_batches_stored",
+        "lane_fetch_served",
+        "lane_acks_rejected",
+        "lane_store_evicted",
+        "committed_bytes_per_s",
         # transport/net.py — wire health
         "net_sends",
         "net_sends_ok",
@@ -417,6 +426,16 @@ class Metrics:
                 if rounds:
                     out["host_pump_ms_per_round"] = round(
                         1e3 * self.pump_seconds_total / rounds, 3
+                    )
+                committed = (self.mempool or {}).get("delivered_bytes", 0)
+                if committed:
+                    # payload bytes committed per second of ordering-path
+                    # (pump) time — the lanes A/B headline (ISSUE 17):
+                    # with dissemination on worker lanes, this must keep
+                    # scaling as block weight grows while the pump floor
+                    # stays flat
+                    out["committed_bytes_per_s"] = round(
+                        committed / self.pump_seconds_total
                     )
         if "cert_path_enabled" in self.counters:
             # aggregated round-certificate gauges (ISSUE 9): the cert

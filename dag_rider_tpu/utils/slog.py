@@ -86,6 +86,12 @@ KNOWN_EVENTS = frozenset(
         "verify_exhausted",
         "verify_window_poisoned",
         "verify_quarantined",
+        # dissemination lanes (ISSUE 17)
+        "lane_batch",
+        "lane_certified",
+        "lane_degrade",
+        "lane_fetch",
+        "lane_restore",
         # transport wire health
         "net_peer_down",
         "net_peer_recovered",

@@ -144,6 +144,22 @@ class CertSigner:
 
         return bls.sign_many([self._sk] * len(digests), digests)
 
+    #: domain tag for lane availability acks (ISSUE 17) — keeps an ack
+    #: share from ever being replayable as a vertex cert share: both are
+    #: BLS signatures under the same key, but a cert share signs a raw
+    #: vertex digest while an ack signs the tagged batch digest
+    LANE_ACK_DOMAIN = b"dagrider-lane-ack-v1|"
+
+    def sign_availability(self, digest: bytes) -> bytes:
+        """Sign a lane-batch availability ack: the attestation that this
+        process holds (and has integrity-checked) the payload bytes
+        hashing to ``digest``. 2f+1 of these aggregate into the batch
+        availability certificate via :meth:`CertVerifier.aggregate` —
+        the same G1 share machinery as round certificates."""
+        from dag_rider_tpu.crypto import bls12381 as bls
+
+        return bls.sign(self._sk, self.LANE_ACK_DOMAIN + digest)
+
 
 class VerifierUnavailableError(RuntimeError):
     """A verifier backend could not be reached or could not complete an

@@ -1,0 +1,425 @@
+"""Sharded dissemination lanes (ISSUE 17): digest-only ordering.
+
+``DAGRIDER_LANES`` moves payload bytes off the consensus path — the
+vertex carries a constant-size certified digest, worker lanes move the
+batch, delivery resolves the digest back — and must change NOTHING the
+client can observe: commit order and delivered transaction bytes are
+pinned identical to the inline oracle across n x adversary x pump (the
+seeded fuzz matrix here), the carrier codec round-trips byte-exactly,
+lane state survives a checkpoint/restore (and pre-lanes checkpoints
+restore with lanes empty), and the two lane-layer Byzantine strategies
+(batch withholding, garbage availability acks) degrade to fetch-on-miss
+or the inline path with zero transaction loss.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from dag_rider_tpu.config import Config
+from dag_rider_tpu.consensus.adversary import ByzantineProcess, make_behavior
+from dag_rider_tpu.consensus.process import Process
+from dag_rider_tpu.consensus.scenarios import Scenario, run_scenario
+from dag_rider_tpu.consensus.simulator import Simulation
+from dag_rider_tpu.core import codec
+from dag_rider_tpu.core.types import Block, LaneRef, Vertex, VertexID
+from dag_rider_tpu.lanes import LaneCoordinator
+from dag_rider_tpu.transport.lanebus import LaneBus
+from dag_rider_tpu.utils import checkpoint
+from dag_rider_tpu.utils.metrics import Metrics
+
+
+# -- carrier codec ----------------------------------------------------------
+
+
+def test_lane_ref_codec_roundtrip():
+    ref = LaneRef(
+        producer=3,
+        seq=17,
+        digest=bytes(range(32)),
+        count=9,
+        nbytes=4096,
+        signers=(0, 2, 3),
+        agg_sig=bytes(range(48)),
+    )
+    tx = codec.encode_lane_ref(ref)
+    assert tx.startswith(codec.LANE_MAGIC)
+    assert codec.decode_lane_ref(tx) == ref
+    # unsigned shape (keyless simulator)
+    bare = LaneRef(0, 0, b"\x00" * 32, 1, 64)
+    assert codec.decode_lane_ref(codec.encode_lane_ref(bare)) == bare
+
+
+def test_lane_ref_of_shapes():
+    ref = LaneRef(1, 2, b"\xab" * 32, 3, 128, signers=(0, 1, 2))
+    tx = codec.encode_lane_ref(ref)
+    assert codec.lane_ref_of(Block((tx,))) == ref
+    # ordinary client payloads are never refs
+    assert codec.decode_lane_ref(b"client tx") is None
+    assert codec.lane_ref_of(Block((b"a", b"b"))) is None
+    # a carrier must be the ONLY transaction
+    assert codec.lane_ref_of(Block((tx, b"extra"))) is None
+    # strict decode rejects trailing garbage...
+    with pytest.raises(ValueError):
+        codec.decode_lane_ref(tx + b"x")
+    # ...but the delivery-path helper treats a malformed magic-prefixed
+    # tx (Byzantine-crafted — honest publishes round-trip) as a payload
+    # block rather than crashing resolve
+    assert codec.lane_ref_of(Block((tx + b"x",))) is None
+    assert codec.lane_ref_of(Block((codec.LANE_MAGIC + b"\x01",))) is None
+
+
+# -- coordinator unit behavior ---------------------------------------------
+
+
+def _cluster(n=4, min_bytes=64, workers=2):
+    cfg = Config(
+        n=n, lanes=True, lane_batch_bytes=min_bytes, lane_workers=workers
+    )
+    bus = LaneBus(n, workers=workers)
+    coords = [
+        LaneCoordinator(cfg, i, bus.endpoint(i), metrics=Metrics())
+        for i in range(n)
+    ]
+    return cfg, bus, coords
+
+
+def _big_block(tag: bytes, nbytes: int = 512) -> Block:
+    return Block((tag.ljust(nbytes, b"."),))
+
+
+def test_publish_certifies_and_resolves():
+    _, bus, coords = _cluster()
+    block = _big_block(b"payload-a")
+    pending = coords[0].begin_publish(block)
+    assert pending is not None
+    assert pending.transactions == block.transactions  # queue-reader view
+    carrier = coords[0].materialize(pending)
+    ref = codec.lane_ref_of(carrier)
+    assert ref is not None
+    assert ref.producer == 0 and ref.count == 1
+    assert len(ref.signers) == coords[0].quorum
+    assert coords[0].metrics.counters["lane_batches_certified"] == 1
+    # every process resolves the carrier back to the exact payload
+    for c in coords:
+        v = Vertex(id=VertexID(1, 0), block=carrier)
+        assert c.resolve_vertex(v).block == block
+    # non-carrier vertices pass through untouched (inline oracle path)
+    plain = Vertex(id=VertexID(1, 1), block=block)
+    assert coords[1].resolve_vertex(plain) is plain
+
+
+def test_small_and_magic_aliasing_blocks_ship_inline():
+    _, _, coords = _cluster(min_bytes=256)
+    assert coords[0].begin_publish(Block((b"tiny",))) is None
+    assert coords[0].begin_publish(Block(())) is None
+    alias = Block(((codec.LANE_MAGIC + b"x").ljust(512, b"!"),))
+    assert coords[0].begin_publish(alias) is None
+    # materialize passes plain blocks straight through
+    assert coords[0].materialize(alias) is alias
+
+
+def test_under_quorum_publish_degrades_to_inline():
+    _, _, coords = _cluster()
+    coords[0]._broadcast_batch = lambda digest, payload: 0  # withhold from all
+    block = _big_block(b"withheld")
+    out = coords[0].materialize(coords[0].begin_publish(block))
+    assert out == block  # the inline oracle, byte-identical
+    assert coords[0].metrics.counters["lane_publish_degraded"] == 1
+    assert coords[0].metrics.counters["lane_batches_certified"] == 0
+
+
+def test_fetch_on_miss_recovers_from_certified_holder():
+    _, _, coords = _cluster()
+    block = _big_block(b"fetch-me")
+    carrier = coords[0].materialize(coords[0].begin_publish(block))
+    ref = codec.lane_ref_of(carrier)
+    # simulate a receiver that never saw the batch (washed out / late
+    # join): wipe its store, then resolve — must pull from a signer
+    victim = coords[3]
+    with victim._lock:
+        victim._store.clear()
+    v = Vertex(id=VertexID(1, 0), block=carrier)
+    assert victim.resolve_vertex(v).block == block
+    assert victim.metrics.counters["lane_fetch_misses"] == 1
+    served = sum(c.stats()["served"] for c in coords)
+    assert served >= 1
+    # unrecoverable (no holder anywhere) fails loudly, not silently
+    ghost = LaneRef(0, 99, b"\x13" * 32, 1, 64, signers=(0, 1, 2))
+    phantom = Block((codec.encode_lane_ref(ghost),))
+    with pytest.raises(RuntimeError):
+        victim.resolve_vertex(Vertex(id=VertexID(2, 0), block=phantom))
+
+
+def test_coordinator_checkpoint_roundtrip():
+    _, _, coords = _cluster()
+    block = _big_block(b"persist")
+    carrier = coords[0].materialize(coords[0].begin_publish(block))
+    state = coords[0].checkpoint_state()
+    assert state["seq"] == 1 and len(state["batches"]) >= 1
+
+    _, _, fresh = _cluster()
+    fresh[0].restore_state(state)
+    assert fresh[0]._seq == 1
+    assert fresh[0].peek_block(carrier) == block
+    # corrupt batch bytes are re-hashed on the way in and dropped
+    bad = {
+        "version": 1,
+        "seq": 5,
+        "batches": [[state["batches"][0][0], "deadbeef"]],
+    }
+    fresh[1].restore_state(bad)
+    assert fresh[1].stats()["store"] == 0 and fresh[1]._seq == 5
+    # pre-lanes checkpoints restore with lanes empty
+    fresh[2].restore_state(None)
+    assert fresh[2].stats()["store"] == 0 and fresh[2]._seq == 0
+
+
+# -- seeded fuzz matrix: lanes must be invisible ----------------------------
+
+
+def _delivery_fingerprint(sim):
+    """(commit order, delivered-bytes digest) per process. The digest
+    hashes the length-prefixed client transaction bytes actually
+    surfaced — NOT vertex digests — so a carrier that resolved to the
+    wrong payload cannot hide."""
+    orders, digests = [], []
+    for d in sim.deliveries:
+        orders.append([(v.id.round, v.id.source) for v in d])
+        h = hashlib.sha256()
+        for v in d:
+            for tx in v.block.transactions:
+                h.update(len(tx).to_bytes(4, "little"))
+                h.update(tx)
+        digests.append(h.hexdigest())
+    return orders, digests
+
+
+def _run_cluster(n, seed, adversary, pump, lanes, cycles):
+    cfg = Config(
+        n=n,
+        coin="round_robin",
+        propose_empty=True,
+        pump=pump,
+        lanes=lanes,
+        lane_batch_bytes=256,
+        sync_request_cooldown_s=0.0,
+        sync_serve_cooldown_s=0.0,
+        sync_patience=1,
+    )
+    nbyz = cfg.f if adversary else 0
+    behaviors = {
+        i: make_behavior(adversary, seed=seed + 1000 + i)
+        for i in range(nbyz)
+    }
+
+    def factory(pcfg, i, ptp, **kwargs):
+        if i in behaviors:
+            return ByzantineProcess(
+                pcfg, i, ptp, behavior=behaviors[i], **kwargs
+            )
+        return Process(pcfg, i, ptp, **kwargs)
+
+    sim = Simulation(cfg, process_factory=factory if behaviors else None)
+    sim.submit_blocks(2, tx_bytes=600)  # above the 256-byte lane floor
+    for _ in range(cycles):
+        sim.run(max_messages=n * (n - 1))
+    return sim
+
+
+MATRIX = [
+    (4, 11, None),
+    (4, 12, "equivocate"),
+    (4, 13, "withhold"),
+    (16, 14, None),
+    (16, 15, "equivocate"),
+    (16, 16, "withhold"),
+    (32, 17, None),
+]
+
+
+@pytest.mark.parametrize("pump", ["scalar", "vector"])
+@pytest.mark.parametrize("n,seed,adversary", MATRIX)
+def test_lanes_identical_to_inline_oracle(n, seed, adversary, pump):
+    """The headline invariant: same commit order AND same delivered
+    transaction bytes, lanes vs inline, per honest process."""
+    cycles = 10 if n >= 32 else 14
+    ref = _run_cluster(n, seed, adversary, pump, False, cycles)
+    lane = _run_cluster(n, seed, adversary, pump, True, cycles)
+    ref_orders, ref_digests = _delivery_fingerprint(ref)
+    lane_orders, lane_digests = _delivery_fingerprint(lane)
+    nbyz = Config(n=n).f if adversary else 0
+    for i in range(nbyz, n):
+        assert lane_orders[i] == ref_orders[i], f"commit order @ p{i}"
+        assert lane_digests[i] == ref_digests[i], f"delivered bytes @ p{i}"
+    assert any(len(o) > 0 for o in ref_orders[nbyz:])  # non-vacuous
+    # ...and the lane path genuinely ran: every honest submit cleared
+    # the batch floor, so certified batches must exist cluster-wide
+    certified = sum(
+        p.metrics.counters.get("lane_batches_certified", 0)
+        + p.metrics.counters.get("lane_publish_degraded", 0)
+        for p in lane.processes
+    )
+    assert certified > 0
+
+
+def test_sub_threshold_blocks_bypass_lanes_entirely():
+    """Blocks under the batch floor never touch the lane machinery —
+    the legacy 32-byte shapes are literally the inline path."""
+    sim = _run_cluster(4, 21, None, "scalar", True, 10)
+    # the matrix harness pads past the floor; rerun small by hand
+    cfg = Config(
+        n=4, lanes=True, lane_batch_bytes=1024, propose_empty=True
+    )
+    small = Simulation(cfg)
+    small.submit_blocks(2, tx_bytes=32)
+    for _ in range(10):
+        small.run(max_messages=12)
+    assert sum(
+        p.metrics.counters.get("lane_batches_certified", 0)
+        for p in small.processes
+    ) == 0
+    assert any(len(d) > 0 for d in small.deliveries)
+    del sim
+
+
+# -- lane-layer Byzantine strategies ----------------------------------------
+
+
+def test_lane_withhold_scenario_recovers_every_byte():
+    r = run_scenario(Scenario(n=4, adversary="lane_withhold", seed=3))
+    assert r["invariants"] == {
+        "agreement": True,
+        "commit_uniqueness": True,
+        "zero_loss": True,
+        "liveness": True,
+    }
+    assert r["lanes"] is True
+    assert r["behavior"]["withheld"] > 0  # the attack genuinely ran
+    # withheld batches either forced pull-based recovery or starved the
+    # ack quorum into the inline degrade — both are zero-loss outcomes
+    assert r["lane_fetch_misses"] + r["lane_publish_degraded"] > 0
+    assert r["audit"]["lost"] == 0
+
+
+def test_lane_garbage_ack_scenario_still_certifies():
+    r = run_scenario(Scenario(n=4, adversary="lane_garbage_ack", seed=5))
+    assert r["invariants"]["zero_loss"] and r["invariants"]["agreement"]
+    assert r["behavior"]["mutated"] > 0  # garbage acks were emitted
+    # digest-keyed collection shrugs them off: honest producers still
+    # reach self + (n-1-f) = 2f+1 and certify every batch
+    assert r["lane_batches_certified"] > 0
+    assert r["lane_publish_degraded"] == 0
+    assert r["audit"]["lost"] == 0
+
+
+def test_lane_adversaries_registered():
+    for kind in ("lane_withhold", "lane_garbage_ack"):
+        b = make_behavior(kind, seed=1)
+        assert set(b.stats) >= {"mutated", "withheld", "extra_sent"}
+
+
+# -- checkpoint integration -------------------------------------------------
+
+
+def _lane_sim(n=4):
+    cfg = Config(
+        n=n, lanes=True, lane_batch_bytes=256, propose_empty=True
+    )
+    sim = Simulation(cfg)
+    sim.submit_blocks(3, tx_bytes=600)
+    sim.run(max_messages=400)  # partial: likely mid-dissemination
+    return cfg, sim
+
+
+def test_checkpoint_roundtrips_lane_state(tmp_path):
+    """Kill-and-restore mid-dissemination loses no accepted
+    transaction: the lane store rides the manifest, pending publishes
+    degrade to inline via their serialized payload blocks."""
+    cfg, sim = _lane_sim()
+    p0 = sim.processes[0]
+    pre_store = p0.lanes.stats()["store"]
+    pre_seq = p0.lanes._seq
+    pre_queue = [b.transactions for b in p0.blocks_to_propose]
+    ckpt = str(tmp_path / "p0")
+    checkpoint.save(p0, ckpt)
+
+    from dag_rider_tpu.transport.lanebus import LaneBus as _LB
+    from dag_rider_tpu.transport.memory import InMemoryTransport
+
+    cfg2 = Config(n=4, lanes=True, lane_batch_bytes=256)
+    p0b = Process(cfg2, 0, InMemoryTransport())
+    bus2 = _LB(4, workers=2)
+    p0b.attach_lanes(
+        LaneCoordinator(cfg2, 0, bus2.endpoint(0), metrics=p0b.metrics)
+    )
+    checkpoint.restore(p0b, ckpt)
+    assert p0b.lanes.stats()["store"] == pre_store
+    assert p0b.lanes._seq == pre_seq
+    # in-flight publishes came back as plain payload blocks — the
+    # accepted transactions, not the (lost) dissemination handles
+    assert [b.transactions for b in p0b.blocks_to_propose] == pre_queue
+    assert all(isinstance(b, Block) for b in p0b.blocks_to_propose)
+    # every certified batch held pre-crash still resolves post-restore
+    for d_hex, _ in p0.lanes.checkpoint_state()["batches"]:
+        digest = bytes.fromhex(d_hex)
+        with p0b.lanes._lock:
+            assert digest in p0b.lanes._store
+
+
+def test_pre_lanes_checkpoint_restores_with_lanes_empty(tmp_path):
+    """A manifest written by a lanes-off build has no "lanes" key; a
+    lanes-on restart must restore it cleanly with an empty store."""
+    cfg = Config(n=4, lanes=False)
+    sim = Simulation(cfg)
+    sim.submit_blocks(2)
+    sim.run(max_messages=200)
+    ckpt = str(tmp_path / "old")
+    checkpoint.save(sim.processes[0], ckpt)
+
+    from dag_rider_tpu.transport.memory import InMemoryTransport
+
+    cfg2 = Config(n=4, lanes=True, lane_batch_bytes=256)
+    p = Process(cfg2, 0, InMemoryTransport())
+    bus = LaneBus(4, workers=2)
+    p.attach_lanes(
+        LaneCoordinator(cfg2, 0, bus.endpoint(0), metrics=p.metrics)
+    )
+    checkpoint.restore(p, ckpt)
+    assert p.lanes.stats()["store"] == 0
+    assert p.round == sim.processes[0].round
+
+
+def test_lanes_off_checkpoint_unchanged(tmp_path):
+    """A lanes-off process writes no "lanes" manifest key at all."""
+    import json
+    import os
+
+    cfg = Config(n=4, lanes=False)
+    sim = Simulation(cfg)
+    sim.submit_blocks(1)
+    sim.run(max_messages=100)
+    ckpt = str(tmp_path / "off")
+    checkpoint.save(sim.processes[0], ckpt)
+    with open(os.path.join(ckpt, "manifest.json")) as fh:
+        assert "lanes" not in json.load(fh)
+
+
+# -- mempool byte accounting ------------------------------------------------
+
+
+def test_mempool_tracks_delivered_bytes():
+    from dag_rider_tpu.mempool import Mempool
+
+    mp = Mempool(clock=lambda: 0.0)
+    txs = [b"x" * 100, b"y" * 50]
+    mp.submit(txs)
+    mp.observe_delivered(Block(tuple(txs)), now=1.0)
+    s = mp.stats()
+    assert s["delivered_txs"] == 2
+    assert s["delivered_bytes"] == 150
+    # peers' unknown payloads never count
+    mp.observe_delivered(Block((b"z" * 999,)), now=2.0)
+    assert mp.stats()["delivered_bytes"] == 150
