@@ -1662,6 +1662,163 @@ def _cert_phase2_rung(n: int = 256, span: int = 4) -> dict:
     return entry
 
 
+def _cluster_e2e_rung(
+    n: int = 4,
+    load_s: float = 6.0,
+    rate: float = 300.0,
+    transport: str = "uds",
+    seed: int = 7,
+    boot_s: float = 15.0,
+) -> dict:
+    """Ladder rung (ISSUE 19): the full stack as n separate OS processes
+    over real sockets. Two cells:
+
+    - **clean**: boot n nodes, drive seeded open-loop load through the
+      wire-level Submit door, stop, audit. Reports committed-tx/s and
+      wire submit→deliver p50/p99.
+    - **kill_rejoin**: same load, but one node (seeded pick, never the
+      client's primary) gets a genuine SIGKILL mid-load, then restarts
+      from its checkpoint + WAL and rejoins via snapshot sync.
+
+    Gates (the rung RAISES on any): both audits clean — commit-order
+    agreement (rejoiner checked as an order-preserving embedding), zero
+    lost accepted transactions, no duplicate delivery, liveness, empty
+    flight recorders; byte-identical committed prefix across the steady
+    nodes of each cell; the kill cell genuinely killed and restarted;
+    and the clean cell committed something.
+    """
+    import shutil
+    import tempfile
+    import threading as _th
+
+    from dag_rider_tpu.cluster import audit as _caudit
+    from dag_rider_tpu.cluster import client as _cclient
+    from dag_rider_tpu.cluster.directory import build_cluster
+    from dag_rider_tpu.cluster.supervisor import (
+        ClusterSupervisor,
+        seeded_kill_plan,
+    )
+
+    def _cell(name: str, plan: list) -> dict:
+        root = tempfile.mkdtemp(prefix=f"dagrider-bench-{name}-")
+        spec = build_cluster(root, n, transport=transport, seed=seed)
+        sup = ClusterSupervisor(spec)
+        t0 = time.monotonic()
+        sup.start_all()
+        not_ready = sup.wait_ready(boot_s)
+        if not_ready:
+            sup.stop_all()
+            raise AssertionError(
+                f"cluster_e2e {name}: nodes {not_ready} not ready in "
+                f"{boot_s}s (workspace kept at {root})"
+            )
+        boot_wall = time.monotonic() - t0
+        load: dict = {}
+        loader = _th.Thread(
+            target=lambda: load.update(
+                _cclient.drive_load(
+                    spec, duration_s=load_s, rate=rate, seed=seed
+                )
+            ),
+            daemon=True,
+        )
+        loader.start()
+        executed = sup.run_plan(plan)
+        loader.join(timeout=load_s + 60)
+        if executed:
+            sup.wait_ready(boot_s)
+        _th.Event().wait(1.5)  # settle: let in-flight waves commit
+        forced = sup.stop_all()
+        report = _caudit.audit_cluster(
+            spec, restarted=sup.restart_counts.keys()
+        )
+        # byte-identical committed prefix across the steady nodes (a
+        # rejoiner's log — supervised restart or an audit-detected
+        # mid-run state transfer — has a legitimate recovery gap and is
+        # covered by the embedding check inside the audit)
+        steady = [
+            i for i in range(n) if i not in report["rejoined"]
+        ] or list(range(n))
+        recs = {
+            i: _caudit._records(
+                _caudit.read_delivery_log(spec.nodes[i].delivery_log)
+            )
+            for i in steady
+        }
+        k = min(len(r) for r in recs.values())
+        prefix_identical = (
+            len({tuple(r[:k]) for r in recs.values()}) == 1
+        )
+        entry = {
+            "nodes": n,
+            "transport": transport,
+            "boot_s": round(boot_wall, 2),
+            "load": load,
+            "fault_plan": executed,
+            "kills": dict(sup.kill_counts),
+            "restarts": dict(sup.restart_counts),
+            "forced_stops": forced,
+            "ok": report["ok"],
+            "violations": report["violations"],
+            "accepted_tx": report["accepted_tx"],
+            "delivered_tx": report["delivered_tx"],
+            "in_flight_tx": report["in_flight_tx"],
+            "lost_tx": report["lost_tx"],
+            "duplicate_tx": report["duplicate_tx"],
+            "decided_waves": report["decided_waves"],
+            "flight_dump_files": report["flight_dump_files"],
+            "committed_tx_per_sec": round(
+                report["delivered_tx"] / load_s, 1
+            ),
+            "prefix_identical": prefix_identical,
+            "common_prefix_len": k,
+        }
+        for key in (
+            "submit_deliver_p50_ms",
+            "submit_deliver_p99_ms",
+            "latency_samples",
+        ):
+            if key in report:
+                entry[key] = report[key]
+        if report["ok"] and prefix_identical:
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            entry["workspace"] = root  # kept for post-mortem
+        return entry
+
+    clean = _cell("clean", [])
+    kill_at = max(1.0, min(2.0, load_s / 3))
+    kill = _cell(
+        "kill",
+        seeded_kill_plan(
+            seed, n, kill_at_s=kill_at, restart_after_s=1.5
+        ),
+    )
+    entry = {"clean": clean, "kill_rejoin": kill}
+    for name, cell in entry.items():
+        if not cell["ok"]:
+            raise AssertionError(
+                f"cluster_e2e {name} audit failed: {cell['violations']}"
+            )
+        if not cell["prefix_identical"]:
+            raise AssertionError(
+                f"cluster_e2e {name}: steady commit prefixes diverge "
+                f"(common len {cell['common_prefix_len']})"
+            )
+    if clean["delivered_tx"] <= 0:
+        raise AssertionError(f"cluster_e2e clean committed nothing: {clean}")
+    if not kill["kills"] or not kill["restarts"]:
+        raise AssertionError(
+            f"cluster_e2e kill cell never killed/restarted: {kill}"
+        )
+    if kill["lost_tx"]:
+        raise AssertionError(
+            f"cluster_e2e: {kill['lost_tx']} accepted transactions lost "
+            f"across kill -9 + rejoin"
+        )
+    return entry
+
+
 def _measure() -> None:
     budget = float(os.environ.get("DAGRIDER_BENCH_SECONDS", "300"))
     t_start = time.monotonic()
@@ -2571,6 +2728,62 @@ def _measure() -> None:
             _mark(f"ladder lanes FAILED: {e!r}")
     else:
         _mark(f"skipping ladder lanes (left {left():.0f}s)")
+
+    # -- ladder rung (ISSUE 19): real multi-process cluster over sockets
+    # with a kill -9 + rejoin-from-checkpoint cell. Gates: clean audits
+    # (agreement incl. rejoin embedding, zero loss, uniqueness,
+    # liveness, empty flight recorders) and byte-identical steady commit
+    # prefixes — the rung RAISES otherwise.
+    clu_s = float(os.environ.get("DAGRIDER_BENCH_CLUSTER_S", "6"))
+    clu_n = int(os.environ.get("DAGRIDER_BENCH_CLUSTER_N", "4"))
+    clu_rate = float(os.environ.get("DAGRIDER_BENCH_CLUSTER_RATE", "300"))
+    if clu_s > 0 and left() > 2 * clu_s + 60:
+        _mark(
+            f"ladder cluster_e2e: n={clu_n} OS processes over uds, "
+            f"{clu_s:.0f}s load per cell + one SIGKILL/rejoin"
+        )
+        try:
+            t_rung = time.monotonic()
+            entry = _cluster_e2e_rung(n=clu_n, load_s=clu_s, rate=clu_rate)
+            entry["rung_seconds"] = round(time.monotonic() - t_rung, 1)
+            result["ladder"]["cluster_e2e"] = entry
+            ck = entry["kill_rejoin"]
+            _mark(
+                f"ladder cluster_e2e: clean "
+                f"{entry['clean']['committed_tx_per_sec']} committed tx/s "
+                f"(p50 {entry['clean'].get('submit_deliver_p50_ms')} ms / "
+                f"p99 {entry['clean'].get('submit_deliver_p99_ms')} ms); "
+                f"kill-and-rejoin kills={ck['kills']} lost={ck['lost_tx']} "
+                f"prefix_identical={ck['prefix_identical']} "
+                f"flight_dumps={ck['flight_dump_files']}"
+            )
+            emit()
+            import datetime as _dt
+
+            from dag_rider_tpu import config as _cfg
+
+            out_path = os.path.join(
+                _REPO, _cfg.env_str("DAGRIDER_CLUSTER_OUT")
+            )
+            with open(out_path, "w") as fh:
+                json.dump(
+                    {
+                        "schema": "dag-rider-tpu/bench-cluster/v1",
+                        "captured": _dt.datetime.now().isoformat(
+                            timespec="seconds"
+                        ),
+                        "backend": result.get("backend", "cpu"),
+                        "cluster_e2e": entry,
+                    },
+                    fh,
+                    indent=1,
+                )
+                fh.write("\n")
+            _mark(f"ladder cluster_e2e: wrote {out_path}")
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder cluster_e2e FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder cluster_e2e (left {left():.0f}s)")
 
     # -- ladder rung: Byzantine adversary x WAN suite at committee scale.
     # Every adversary class from consensus/adversary.py drives f=10 of

@@ -153,6 +153,17 @@ _register("DAGRIDER_LANE_BATCH_BYTES", "int", 1024,
           "blocks ship inline (the oracle path)", minimum=1)
 _register("DAGRIDER_LANES_OUT", "str", "BENCH_r09.json",
           "lanes-ladder bench output path")
+_register("DAGRIDER_CLUSTER_TRANSPORT", "choice", "uds",
+          "address family for multi-process cluster harness sockets",
+          choices=("uds", "tcp"))
+_register("DAGRIDER_CLUSTER_BOOT_S", "float", 15.0,
+          "per-node readiness timeout when booting cluster processes",
+          minimum=0)
+_register("DAGRIDER_CLUSTER_KEEP", "flag", False,
+          "keep the cluster harness workspace (logs, checkpoints, flight "
+          "dumps) after a run instead of deleting it")
+_register("DAGRIDER_CLUSTER_OUT", "str", "BENCH_r20.json",
+          "cluster-e2e ladder bench output path")
 
 
 def _raw(name: str) -> str:

@@ -99,6 +99,49 @@ def check_commit_uniqueness(logs: Dict[int, Sequence[Record]]) -> None:
                 )
 
 
+def check_rejoin_embedding(
+    canonical: Sequence[Record],
+    log: Sequence[Record],
+    *,
+    view: Optional[int] = None,
+) -> None:
+    """Commit-order agreement for a crash-recovered view.
+
+    A node that died (kill -9), restored from checkpoint, and rejoined
+    via snapshot sync does NOT re-deliver: its on-disk log is the
+    pre-crash prefix followed by the post-rejoin segment, with a
+    legitimate gap covering what the cluster committed while it was dead
+    plus what the snapshot import skipped. Prefix comparison is the
+    wrong invariant there; the right one is an **order-preserving
+    embedding**: every slot the rejoiner delivered that a survivor also
+    delivered must carry the same digest AND appear in the same relative
+    order. (Slots beyond the canonical view's tail — shutdown skew — are
+    exempt here; :func:`check_commit_uniqueness` still cross-checks
+    their digests.)"""
+    pos: Dict[Tuple[int, int], Tuple[int, bytes]] = {
+        (r, s): (k, d) for k, (r, s, d) in enumerate(canonical)
+    }
+    who = "view" if view is None else f"p{view}"
+    last = -1
+    for k, (r, s, d) in enumerate(log):
+        hit = pos.get((r, s))
+        if hit is None:
+            continue
+        cpos, cd = hit
+        if cd != d:
+            raise InvariantViolation(
+                f"rejoin divergence: {who} delivered slot (round={r}, "
+                f"source={s}) as {d!r}, canonical has {cd!r}"
+            )
+        if cpos <= last:
+            raise InvariantViolation(
+                f"rejoin order violation: {who} log position {k} maps to "
+                f"canonical position {cpos}, not after {last} — the "
+                f"recovered segment reorders committed slots"
+            )
+        last = cpos
+
+
 def transaction_audit(
     accepted: Iterable[bytes],
     delivered_by_view: Iterable[Iterable[bytes]],

@@ -227,7 +227,22 @@ class Node:
         peers: Dict[int, str] = {int(k): v for k, v in cfg.get("peers", {}).items()}
         # Lazy: transport/net.py imports grpc at module scope, and grpcio
         # is the optional [net] extra — keygen must work without it.
-        from dag_rider_tpu.transport.net import GrpcTransport
+        from dag_rider_tpu.transport.net import GrpcTransport, WanFault
+
+        # WAN emulation at the real send seam (ISSUE 19): the cluster
+        # harness sets {"wan": {"delay_ms": [lo, hi], "delay_rate": p,
+        # "drop": p, "seed": s}} so delay/drop apply to genuine gRPC
+        # sends between OS processes, not a simulator queue. Seed is
+        # offset by index so peers do not fault in lockstep.
+        wan = cfg.get("wan")
+        send_fault = None
+        if wan:
+            send_fault = WanFault(
+                seed=int(wan.get("seed", 0)) + index,
+                delay_ms=tuple(wan.get("delay_ms", (0.0, 0.0))),
+                delay_rate=float(wan.get("delay_rate", 1.0)),
+                drop=float(wan.get("drop", 0.0)),
+            )
 
         auth = None
         master_hex = cfg.get("auth_master")
@@ -260,6 +275,7 @@ class Node:
             snapshot_freshness_s=(
                 None if snap_fresh is None else float(snap_fresh)
             ),
+            send_fault=send_fault,
             log=self.log,
         )
         transport = self.net
@@ -415,39 +431,74 @@ class Node:
 
         self.delivered = []
         self.mempool = None
-        self.process = Process(
-            self.ccfg,
-            index,
-            transport,
-            coin=coin,
-            verifier=verifier,
-            signer=VertexSigner(seeds[index]),
-            cert_signer=cert_signer,
-            cert_verifier=cert_verifier,
-            on_deliver=self._on_deliver,
-            log=self.log,
-        )
+
+        # Byzantine-over-sockets (ISSUE 19): {"adversary": {"kind":
+        # "equivocate", "seed": 7}} swaps in a ByzantineProcess whose
+        # forged wire output crosses REAL process boundaries — the same
+        # round-11 behaviors, now probing honest admission gates over
+        # gRPC instead of a simulator queue.
+        adv = cfg.get("adversary")
+        behavior = None
+        if adv:
+            from dag_rider_tpu.consensus.adversary import make_behavior
+
+            behavior = make_behavior(
+                adv["kind"], seed=int(adv.get("seed", 0))
+            )
+
+        def _build_process() -> Process:
+            if behavior is not None:
+                from dag_rider_tpu.consensus.adversary import (
+                    ByzantineProcess,
+                )
+
+                proc_cls = ByzantineProcess
+                extra = {"behavior": behavior}
+            else:
+                proc_cls = Process
+                extra = {}
+            return proc_cls(
+                self.ccfg,
+                index,
+                transport,
+                coin=coin,
+                verifier=verifier,
+                signer=VertexSigner(seeds[index]),
+                cert_signer=cert_signer,
+                cert_verifier=cert_verifier,
+                on_deliver=self._on_deliver,
+                log=self.log,
+                **extra,
+            )
+
+        def _attach() -> None:
+            """(Re)bind everything keyed to the current Process's
+            metrics object — also used by the corrupt-checkpoint
+            rebuild path below, which swaps in a fresh Process."""
+            mp_cfg = cfg.get("mempool")
+            if mp_cfg:
+                from dag_rider_tpu.config import MempoolConfig
+                from dag_rider_tpu.mempool import Mempool
+
+                self.mempool = Mempool(
+                    MempoolConfig.from_dict(
+                        mp_cfg if isinstance(mp_cfg, dict) else None
+                    ),
+                    metrics=self.process.metrics,
+                    log=self.process.log,
+                )
+            self.net.attach_metrics(self.process.metrics)
+            if self.tracing is not None:
+                self.tracing.flight.add_metrics_source(
+                    str(index), self.process.metrics.snapshot
+                )
+
+        self.process = _build_process()
         # Round-10 ingestion edge: "mempool": true (env-tuned) or a dict
         # of MempoolConfig overrides attaches the admission + batching
         # front door; submit() then routes through it and the pump pulls
         # built blocks. Absent/false keeps the legacy direct-block path.
-        mp_cfg = cfg.get("mempool")
-        if mp_cfg:
-            from dag_rider_tpu.config import MempoolConfig
-            from dag_rider_tpu.mempool import Mempool
-
-            self.mempool = Mempool(
-                MempoolConfig.from_dict(
-                    mp_cfg if isinstance(mp_cfg, dict) else None
-                ),
-                metrics=self.process.metrics,
-                log=self.process.log,
-            )
-        self.net.attach_metrics(self.process.metrics)
-        if self.tracing is not None:
-            self.tracing.flight.add_metrics_source(
-                str(index), self.process.metrics.snapshot
-            )
+        _attach()
         self.ckpt_dir = cfg.get("checkpoint_dir")
         self.ckpt_every = float(cfg.get("checkpoint_every_s", 30))
         #: per-peer state-transfer fetch deadline — short, because the
@@ -467,11 +518,29 @@ class Node:
         self._submit_queue: Deque[Block] = deque()
         self._stopped = False
 
-        if self.ckpt_dir and checkpoint.latest_round(self.ckpt_dir) is not None:
-            checkpoint.restore(
-                self.process, self.ckpt_dir, mempool=self.mempool
-            )
-            self.log.event("restored", round=self.process.round)
+        if self.ckpt_dir and checkpoint.present(self.ckpt_dir):
+            # present() (not latest_round): a torn manifest must reach
+            # restore() so the corruption is COUNTED, not silently
+            # mistaken for a first boot.
+            try:
+                checkpoint.restore(
+                    self.process, self.ckpt_dir, mempool=self.mempool
+                )
+                self.log.event("restored", round=self.process.round)
+            except checkpoint.CorruptCheckpointError as e:
+                # kill -9 landed mid-save on a pre-atomic layout, or the
+                # disk bit-rotted: start empty (fresh Process — restore
+                # validates before mutating, but a rebuild costs nothing
+                # and guarantees genesis state) and let snapshot sync
+                # re-join us past whatever the cluster pruned. Accepted
+                # transactions are the WAL's job, not the checkpoint's.
+                unsub = getattr(transport, "unsubscribe", None)
+                if unsub is not None:
+                    unsub()
+                self.process = _build_process()
+                _attach()
+                self.process.metrics.inc("checkpoint_corrupt")
+                self.log.event("checkpoint_corrupt", error=str(e)[:200])
 
     def _on_deliver(self, vertex) -> None:
         self.delivered.append(vertex)

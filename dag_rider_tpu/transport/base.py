@@ -34,8 +34,13 @@ def resolve_unicast(transport) -> Optional[Callable]:
     Stops with None at any layer that declares ``requires_broadcast``
     (RbcTransport): Bracha's totality/catch-up depends on every peer
     seeing repeat VALs, so honest senders must not tunnel past it.
-    (The Byzantine adversary seam in consensus/adversary.py unwraps
-    unconditionally — NOT honoring the contract is the attack.)
+    A layer may also declare ``protocol_unicast = False`` to keep its
+    ``enqueue`` OUT of honest protocol routing while still exposing it
+    to the Byzantine adversary seam (GrpcTransport: single-copy sync
+    over a real socket loses whole patience windows to send failures
+    during recovery, so honest serves keep broadcast redundancy).
+    (The adversary seam in consensus/adversary.py unwraps
+    unconditionally — NOT honoring these contracts is the attack.)
 
     Returns None when the stack has no such seam; callers degrade to
     broadcast."""
@@ -46,7 +51,7 @@ def resolve_unicast(transport) -> Optional[Callable]:
         if getattr(tp, "requires_broadcast", False):
             return None
         fn = getattr(tp, "enqueue", None)
-        if callable(fn):
+        if callable(fn) and getattr(tp, "protocol_unicast", True):
             return fn
         tp = getattr(tp, "inner", None)
     return None

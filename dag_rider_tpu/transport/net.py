@@ -20,11 +20,12 @@ stays single-threaded (SURVEY.md D4's fix) — only the inbox is shared.
 from __future__ import annotations
 
 import math
+import random
 import struct
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, Optional
+from typing import Callable, Deque, Dict, Optional, Tuple
 
 import grpc
 
@@ -36,11 +37,60 @@ from dag_rider_tpu.utils.metrics import Metrics
 _SERVICE = "dagrider.Transport"
 _METHOD = f"/{_SERVICE}/Deliver"
 _SNAPSHOT_METHOD = f"/{_SERVICE}/Snapshot"
+_SUBMIT_METHOD = f"/{_SERVICE}/Submit"
 
 _identity = lambda b: b  # noqa: E731 — bytes in, bytes out
 
 
 _SNAP_DOMAIN = b"dagrider-snapshot-req-v2"  # v2: timestamped request body
+
+
+class WanFault:
+    """Seeded WAN delay/drop policy applied at the gRPC send seam.
+
+    Called once per network attempt with the destination peer; returns a
+    verdict: negative = drop this attempt (the bytes never leave the
+    host), positive = hold the attempt for that many seconds before it
+    goes out, zero = send immediately. Seeded so a cluster scenario's
+    fault schedule replays; ``delay_ms`` is a (low, high) uniform window
+    and ``rate`` the fraction of attempts delayed at all.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        *,
+        delay_ms: Tuple[float, float] = (0.0, 0.0),
+        delay_rate: float = 1.0,
+        drop: float = 0.0,
+    ) -> None:
+        lo, hi = float(delay_ms[0]), float(delay_ms[1])
+        if lo < 0 or hi < lo:
+            raise ValueError(f"delay_ms needs 0 <= low <= high, got {delay_ms}")
+        if not 0.0 <= drop <= 1.0:
+            raise ValueError(f"drop must be in [0, 1], got {drop}")
+        if not 0.0 <= delay_rate <= 1.0:
+            raise ValueError(f"delay_rate must be in [0, 1], got {delay_rate}")
+        self._rng = random.Random(seed)
+        self._delay = (lo, hi)
+        self._delay_rate = delay_rate
+        self._drop = drop
+        self._lock = threading.Lock()
+
+    def __call__(self, peer: int) -> float:
+        # _send runs on the owner thread AND retry-timer threads; the
+        # generator state must not interleave or the seeded schedule
+        # stops being a schedule.
+        with self._lock:
+            if self._drop and self._rng.random() < self._drop:
+                return -1.0
+            lo, hi = self._delay
+            if hi > 0 and (
+                self._delay_rate >= 1.0
+                or self._rng.random() < self._delay_rate
+            ):
+                return self._rng.uniform(lo, hi) / 1e3
+        return 0.0
 
 
 class _DeliverHandler(grpc.GenericRpcHandler):
@@ -53,10 +103,16 @@ class _DeliverHandler(grpc.GenericRpcHandler):
         snapshot_freshness_s: Optional[float] = 300.0,
         metrics_inc: Optional[Callable[[str], None]] = None,
         wall_clock: Callable[[], float] = time.time,
+        submit_sink: Optional[Callable[[], Optional[Callable]]] = None,
     ):
         self._sink = sink
         self._snapshot = snapshot_provider
         self._auth = auth
+        # late-bound client front door (cluster runner): a zero-arg
+        # getter so the owner can wire the sink after construction
+        self._submit_sink = submit_sink if submit_sink is not None else (
+            lambda: None
+        )
         self._inc = metrics_inc if metrics_inc is not None else lambda _n: None
         # Injectable wall clock (tests/virtual time): freshness is a
         # cross-host comparison, so it NEEDS wall time in production —
@@ -125,6 +181,29 @@ class _DeliverHandler(grpc.GenericRpcHandler):
 
             return grpc.unary_unary_rpc_method_handler(
                 unary,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+        if handler_call_details.method == _SUBMIT_METHOD:
+            # Client mempool front door (cluster mode): clients are not
+            # committee members, so this endpoint is not MAC-gated — the
+            # sink behind it is the node's own admission control, whose
+            # whole job is surviving untrusted load (throttle/shed).
+            sink = self._submit_sink()
+            if sink is None:
+                return None
+
+            def submit(request: bytes, context) -> bytes:
+                self._inc("net_client_submits")
+                try:
+                    return sink(request)
+                except Exception:  # noqa: BLE001 — a malformed client
+                    # frame must not crash the server thread; empty =
+                    # refusal, the client treats it as not-accepted.
+                    return b""
+
+            return grpc.unary_unary_rpc_method_handler(
+                submit,
                 request_deserializer=_identity,
                 response_serializer=_identity,
             )
@@ -298,6 +377,7 @@ class GrpcTransport(Transport):
         snapshot_min_interval_s: float = 1.0,
         snapshot_freshness_s: Optional[float] = 300.0,
         wall_clock: Callable[[], float] = time.time,
+        send_fault: Optional[Callable[[int], float]] = None,
         log=None,
     ):
         from dag_rider_tpu.utils.slog import NOOP
@@ -330,6 +410,15 @@ class GrpcTransport(Transport):
         self._timers: set = set()
         self._closed = False
         self._snap_req_ts = float("-inf")  # monotone request-ts floor
+        #: injected WAN policy (cluster chaos): per-attempt delay/drop
+        #: applied before the bytes reach gRPC — see :class:`WanFault`
+        self._send_fault = send_fault
+        #: late-bound client Submit sink (set_submit_sink)
+        self._submit_fn: Optional[Callable[[bytes], bytes]] = None
+        # Retry-backoff jitter (seeded per endpoint so scenarios replay):
+        # a restarted peer coming back mid-burst must not see every
+        # sender's exhausted retry chains re-fire in lockstep.
+        self._jitter = random.Random(0x6A17 + index)
         # Observability (round-2 VERDICT weak #8: RpcErrors were silently
         # swallowed — a flaky peer degraded to permanent round lag with
         # zero counter movement). Shared with the process's Metrics when
@@ -360,6 +449,7 @@ class GrpcTransport(Transport):
                     snapshot_freshness_s=snapshot_freshness_s,
                     metrics_inc=self._inc,
                     wall_clock=wall_clock,
+                    submit_sink=lambda: self._submit_fn,
                 ),
             )
         )
@@ -444,6 +534,12 @@ class GrpcTransport(Transport):
             raise ValueError("already subscribed")
         self._handler = handler
 
+    def unsubscribe(self) -> None:
+        """Release the process slot so a rebuilt state machine can
+        subscribe (corrupt-checkpoint recovery swaps in a fresh
+        Process over the same live socket)."""
+        self._handler = None
+
     def broadcast(self, msg: BroadcastMessage) -> None:
         payload = codec.encode_message(msg)
         if self._auth is not None:
@@ -462,7 +558,65 @@ class GrpcTransport(Transport):
                 continue
             self._send(peer, payload, attempt=0)
 
+    #: keep this enqueue OUT of honest protocol routing
+    #: (base.resolve_unicast): single-copy sync serves over a real
+    #: socket lose whole patience windows to transient send failures
+    #: during recovery — measured as a restarted node chasing a moving
+    #: head it never caught. The Byzantine seam ignores this gate.
+    protocol_unicast = False
+
+    def enqueue(self, dest: int, msg: BroadcastMessage) -> None:
+        """Point-to-point send — the per-destination seam Byzantine
+        behaviors resolve (consensus/adversary._resolve_enqueue), so
+        selective strategies like ``withhold`` stay per-destination
+        across a real process boundary instead of degrading to
+        broadcast-or-nothing."""
+        if dest == self.index or dest not in self._peers:
+            return
+        payload = codec.encode_message(msg)
+        if self._auth is not None:
+            payload = (
+                struct.pack("<I", self.index)
+                + payload
+                + self._auth.tag(dest, payload)
+            )
+        self._send(dest, payload, attempt=0)
+
+    def set_submit_sink(self, fn: Optional[Callable[[bytes], bytes]]) -> None:
+        """Open (or close, with None) the client Submit front door:
+        ``fn`` receives the raw request bytes and returns the response
+        bytes. Wired late by the cluster node runner — the sink needs
+        the fully built node, which needs this transport first."""
+        self._submit_fn = fn
+
     def _send(self, peer: int, payload: bytes, attempt: int) -> None:
+        if self._closed:
+            return
+        if self._send_fault is not None:
+            verdict = self._send_fault(peer)
+            if verdict < 0:
+                # injected WAN loss: the attempt never leaves the host.
+                # Deliberately NOT charged to the failure detector — a
+                # lossy link is not a down peer, and consensus recovers
+                # through later broadcasts / anti-entropy.
+                self._inc("net_wan_drops")
+                return
+            if verdict > 0:
+                self._inc("net_wan_delays")
+                timer = threading.Timer(
+                    verdict,
+                    lambda: (
+                        self._timers.discard(timer),
+                        self._send_now(peer, payload, attempt),
+                    ),
+                )
+                timer.daemon = True
+                self._timers.add(timer)
+                timer.start()
+                return
+        self._send_now(peer, payload, attempt)
+
+    def _send_now(self, peer: int, payload: bytes, attempt: int) -> None:
         if self._closed:
             return
         self._inc("net_sends")
@@ -521,7 +675,27 @@ class GrpcTransport(Transport):
                 self.metrics.inc("net_send_errors")
                 self.metrics.inc("net_drops")
                 self._consec_fail[peer] = self._consec_fail.get(peer, 0) + 1
-                just_down = self._consec_fail[peer] == self.down_after
+                fails = self._consec_fail[peer]
+                just_down = fails == self.down_after
+                # Channel recycle for restart recovery: once a peer
+                # trips down (and every 8th exhausted chain after),
+                # drop the cached channel so a later send re-dials
+                # fresh. A peer that died and came back ON THE SAME
+                # ADDRESS then reconnects within a few chains instead
+                # of waiting out gRPC's internal subchannel backoff
+                # (up to ~2 min idle after a long outage) — and the old
+                # channel is closed, not leaked. Throttled: re-dialing
+                # on EVERY chain while the peer stays dead churns a
+                # fresh channel (threads, fds, connect timeouts) per
+                # logical message and measurably drags the live quorum.
+                chan = None
+                if fails == self.down_after or (
+                    fails > self.down_after and fails % 8 == 0
+                ):
+                    chan = self._channels.pop(peer, None)
+                    self._stubs.pop(peer, None)
+            if chan is not None:
+                chan.close()
             if just_down:
                 self._inc("net_peer_down")
                 self.log.event(
@@ -533,7 +707,11 @@ class GrpcTransport(Transport):
         with self._lock:
             self.metrics.inc("net_send_errors")
             self.metrics.inc("net_retries")
-        delay = self._retry_backoff_s * (2**attempt)
+            # +/-25% seeded jitter: a restarted peer must not absorb
+            # every sender's backed-off retries in one synchronized
+            # thundering burst.
+            jitter = 0.75 + 0.5 * self._jitter.random()
+        delay = self._retry_backoff_s * (2**attempt) * jitter
         timer = threading.Timer(
             delay, lambda: (self._timers.discard(timer),
                             self._send(peer, payload, attempt + 1))

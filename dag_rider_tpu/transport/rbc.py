@@ -129,6 +129,14 @@ class RbcTransport(Transport):
         self._handler = handler
         self.inner.subscribe(index, self._on_inner)
 
+    def unsubscribe(self) -> None:
+        """Release this slot and the inner transport's — a rebuilt
+        Process (corrupt-checkpoint recovery) re-subscribes the chain."""
+        self._handler = None
+        unsub = getattr(self.inner, "unsubscribe", None)
+        if unsub is not None:
+            unsub()
+
     def broadcast(self, msg: BroadcastMessage) -> None:
         """r_bcast: send VAL and join the echo voting for our own vertex
         (the inner broker excludes the sender from fan-out, so the sender's

@@ -13,6 +13,7 @@ restored, so no vertex is a_delivered twice across a crash.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import struct
@@ -27,6 +28,49 @@ MANIFEST = "manifest.json"
 TENSORS = "dag.npz"
 VERTICES = "vertices.bin"
 MEMPOOL = "mempool.json"
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint directory failed validation before restore touched
+    the process: torn manifest (kill -9 mid-save on a pre-atomic-rename
+    layout), sidecar hash mismatch, or undecodable vertex bytes. The
+    caller's process is guaranteed untouched — the node runtime treats
+    this as "no usable checkpoint", starts empty, and recovers via the
+    snapshot-sync rejoin path."""
+
+
+def present(path: str) -> bool:
+    """Does ``path`` hold *something that claims to be* a checkpoint?
+
+    Distinct from :func:`latest_round` (which answers None for both
+    "absent" and "unreadable"): the node runtime must distinguish a
+    first boot (no manifest — start empty silently) from a torn or
+    corrupt checkpoint (manifest present but restore fails — bump the
+    ``checkpoint_corrupt`` counter so operators see the data loss)."""
+    return os.path.exists(os.path.join(path, MANIFEST))
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_atomic(path: str, writer) -> str:
+    """Write via ``writer(fh)`` to ``path + ".tmp"``, fsync, rename into
+    place. Returns the sha256 hex of the written bytes. A kill -9 at any
+    point leaves either the previous file or the new one — never a torn
+    hybrid (os.replace is atomic on POSIX)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        writer(fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    digest = _file_sha256(tmp)
+    os.replace(tmp, path)
+    return digest
 
 
 def save(process, path: str, *, mempool=None) -> None:
@@ -45,10 +89,19 @@ def save(process, path: str, *, mempool=None) -> None:
     """
     os.makedirs(path, exist_ok=True)
     exists, strong = process.dag.dense_snapshot()
-    np.savez_compressed(
-        os.path.join(path, TENSORS), exists=exists, strong=strong
-    )
-    with open(os.path.join(path, VERTICES), "wb") as fh:
+
+    # Sidecars first, each atomically (tmp + fsync + rename), manifest
+    # LAST: the manifest rename is the commit point. A kill -9 anywhere
+    # in this sequence leaves either the previous complete checkpoint or
+    # the new one — the only torn window is "new sidecars under the old
+    # manifest", which the manifest's sidecar hashes detect at restore
+    # (CorruptCheckpointError -> node starts empty and rejoins via
+    # snapshot sync; accepted transactions are covered by the WAL, not
+    # the checkpoint, so this loses no data).
+    def _write_tensors(fh):
+        np.savez_compressed(fh, exists=exists, strong=strong)
+
+    def _write_vertices(fh):
         for v in process.dag.vertices.values():
             payload = codec.encode_vertex(v)
             fh.write(struct.pack("<I", len(payload)))
@@ -58,6 +111,22 @@ def save(process, path: str, *, mempool=None) -> None:
             payload = codec.encode_vertex(v)
             fh.write(struct.pack("<I", len(payload) | 0x80000000))
             fh.write(payload)
+
+    hashes = {
+        TENSORS: _write_atomic(os.path.join(path, TENSORS), _write_tensors),
+        VERTICES: _write_atomic(
+            os.path.join(path, VERTICES), _write_vertices
+        ),
+    }
+    if mempool is not None:
+        pool_state = mempool.checkpoint_state()
+
+        def _write_mempool(fh):
+            fh.write(json.dumps(pool_state).encode())
+
+        hashes[MEMPOOL] = _write_atomic(
+            os.path.join(path, MEMPOOL), _write_mempool
+        )
     manifest = {
         "version": 1,
         "index": process.index,
@@ -107,17 +176,15 @@ def save(process, path: str, *, mempool=None) -> None:
     # path. Absent in pre-lanes manifests -> lanes restore empty.
     if getattr(process, "lanes", None) is not None:
         manifest["lanes"] = process.lanes.checkpoint_state()
-    tmp = os.path.join(path, MANIFEST + ".tmp")
-    with open(tmp, "w") as fh:
-        json.dump(manifest, fh)
-    os.replace(tmp, os.path.join(path, MANIFEST))
-    if mempool is not None:
-        # same atomic-rename discipline as the manifest: a crash
-        # mid-write must leave the previous pending set readable
-        mtmp = os.path.join(path, MEMPOOL + ".tmp")
-        with open(mtmp, "w") as fh:
-            json.dump(mempool.checkpoint_state(), fh)
-        os.replace(mtmp, os.path.join(path, MEMPOOL))
+    # Sidecar digests: restore verifies these before touching the
+    # process, so "old manifest over new sidecars" (or bit rot) is
+    # detected instead of silently restoring a frankenstate.
+    manifest["sha256"] = hashes
+
+    def _write_manifest(fh):
+        fh.write(json.dumps(manifest).encode())
+
+    _write_atomic(os.path.join(path, MANIFEST), _write_manifest)
 
 
 def restore(process, path: str, *, mempool=None) -> None:
@@ -129,25 +196,89 @@ def restore(process, path: str, *, mempool=None) -> None:
     ``mempool``: re-admits the checkpoint's pending transaction set
     (see :func:`save`); checkpoints written before round 10 have no
     ``mempool.json`` and restore cleanly with an empty pool.
+
+    Raises :class:`CorruptCheckpointError` (a ValueError subclass) when
+    the directory fails validation — torn/unparseable manifest, sidecar
+    hash mismatch, undecodable vertex bytes, out-of-bounds cursors. All
+    validation runs BEFORE the process is mutated: on any raise the
+    caller's (genesis-only) process is untouched and safe to run empty.
     """
-    with open(os.path.join(path, MANIFEST)) as fh:
-        manifest = json.load(fh)
-    if manifest["n"] != process.cfg.n or manifest["index"] != process.index:
+    try:
+        with open(os.path.join(path, MANIFEST)) as fh:
+            manifest = json.load(fh)
+        if not isinstance(manifest, dict):
+            raise ValueError("manifest is not an object")
+    except (OSError, ValueError) as exc:
+        raise CorruptCheckpointError(
+            f"unreadable checkpoint manifest in {path}: {exc}"
+        ) from exc
+    try:
+        n_claim, idx_claim = manifest["n"], manifest["index"]
+    except KeyError as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint manifest missing key {exc}"
+        ) from exc
+    if n_claim != process.cfg.n or idx_claim != process.index:
         raise ValueError(
             "checkpoint is for a different committee/process: "
-            f"n={manifest['n']} index={manifest['index']}"
+            f"n={n_claim} index={idx_claim}"
         )
-    with open(os.path.join(path, VERTICES), "rb") as fh:
-        data = fh.read()
-    offset = 0
-    admitted, buffered = [], []
-    while offset < len(data):
-        (tag,) = struct.unpack_from("<I", data, offset)
-        offset += 4
-        ln = tag & 0x7FFFFFFF
-        v, _ = codec.decode_vertex(data[offset : offset + ln])
-        offset += ln
-        (buffered if tag & 0x80000000 else admitted).append(v)
+    # Sidecar integrity gate (absent in pre-round-20 manifests): a
+    # kill -9 between sidecar and manifest renames leaves the OLD
+    # manifest naming hashes the NEW sidecars no longer match.
+    for name, want in (manifest.get("sha256") or {}).items():
+        side = os.path.join(path, str(name))
+        try:
+            got = _file_sha256(side)
+        except OSError as exc:
+            raise CorruptCheckpointError(
+                f"checkpoint sidecar {name} unreadable: {exc}"
+            ) from exc
+        if got != want:
+            raise CorruptCheckpointError(
+                f"checkpoint sidecar {name} hash mismatch "
+                f"(manifest {want[:12]}.., file {got[:12]}..)"
+            )
+    try:
+        with open(os.path.join(path, VERTICES), "rb") as fh:
+            data = fh.read()
+        offset = 0
+        admitted, buffered = [], []
+        while offset < len(data):
+            (tag,) = struct.unpack_from("<I", data, offset)
+            offset += 4
+            ln = tag & 0x7FFFFFFF
+            v, _ = codec.decode_vertex(data[offset : offset + ln])
+            offset += ln
+            (buffered if tag & 0x80000000 else admitted).append(v)
+    except (OSError, struct.error, ValueError, IndexError) as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint vertex log undecodable: {exc}"
+        ) from exc
+    # Cursor/bounds validation BEFORE any mutation (the raise-after-
+    # reset path would otherwise leave the caller's process torn).
+    try:
+        base_claim = int(manifest.get("base_round", 0))
+        round_claim = int(manifest["round"])
+        wave_claim = int(manifest["decided_wave"])
+        delivered_claim = [
+            (int(r), int(s)) for r, s in manifest["delivered_log"]
+        ]
+    except (KeyError, TypeError, ValueError) as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint manifest cursors invalid: {exc}"
+        ) from exc
+    top_claim = max(
+        [base_claim] + [v.round for v in admitted], default=base_claim
+    )
+    n = process.cfg.n
+    for r, s in delivered_claim:
+        if not (0 <= s < n) or r < base_claim or r > top_claim:
+            raise CorruptCheckpointError(
+                f"corrupt checkpoint: delivered entry ({r}, {s}) out of "
+                f"bounds for n={n}, base_round={base_claim}"
+            )
+    del round_claim, wave_claim  # validated for type only
     # Rebuild the DAG in round order so insert()'s invariants hold. The
     # admission gate re-runs for every round>=1 vertex: the hot paths
     # (dense-mirror fancy indexing in dag.insert / _drain_buffer) rely on
@@ -193,17 +324,18 @@ def restore(process, path: str, *, mempool=None) -> None:
             ],
         )
     )
-    # Bounds-validate before touching dense state: a crafted/corrupted
-    # manifest entry must fail the restore loudly, not alias a numpy
-    # index (negative source) into a silent order divergence.
-    n = process.cfg.n
+    # Bounds were pre-validated against the manifest's claimed window;
+    # re-check against the dense state actually built (an edges_valid
+    # drop can shrink max_round below the claim — a delivered entry
+    # pointing past it would alias a numpy index into a silent order
+    # divergence, so fail loudly instead).
     base = process.dag.base_round
     log = []
-    for r, s in manifest["delivered_log"]:
-        if not (0 <= s < n) or r < base or r > process.dag.max_round:
-            raise ValueError(
-                f"corrupt checkpoint: delivered entry ({r}, {s}) out of "
-                f"bounds for n={n}, base_round={base}"
+    for r, s in delivered_claim:
+        if r > process.dag.max_round:
+            raise CorruptCheckpointError(
+                f"corrupt checkpoint: delivered entry ({r}, {s}) beyond "
+                f"restored window top {process.dag.max_round}"
             )
         log.append(VertexID(r, s))
     process.delivered_log = log

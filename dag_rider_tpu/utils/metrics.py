@@ -104,6 +104,13 @@ KNOWN_COUNTERS = frozenset(
         "net_snapshot_global_throttled",
         "net_snapshot_fetches",
         "net_snapshot_errors",
+        # transport/net.py — injected WAN faults (cluster harness)
+        "net_wan_drops",
+        "net_wan_delays",
+        # cluster/ — multi-process harness (ISSUE 19)
+        "net_client_submits",
+        "checkpoint_corrupt",
+        "cluster_reinjects",
     }
 )
 

@@ -95,6 +95,9 @@ KNOWN_EVENTS = frozenset(
         # transport wire health
         "net_peer_down",
         "net_peer_recovered",
+        # cluster harness (ISSUE 19): crash-recovery lifecycle
+        "checkpoint_corrupt",
+        "cluster_reinject",
         # obs/ causal tracing (round 16 tentpole): sampled transaction
         # lifecycle stamps + per-cycle phase spans
         "tx_submit",

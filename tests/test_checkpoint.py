@@ -189,3 +189,143 @@ def test_checkpoint_pending_waves_backcompat(tmp_path):
     )
     checkpoint.restore(p0b, ckpt)
     assert p0b._pending_waves == set(p0._pending_waves)
+
+
+# -- round 20: crash-during-save atomicity + corruption containment -----
+
+
+def _saved_checkpoint(tmp_path, name="ck"):
+    cfg = Config(n=4)
+    sim = Simulation(cfg)
+    sim.submit_blocks(2)
+    sim.run(max_messages=300)
+    path = str(tmp_path / name)
+    checkpoint.save(sim.processes[0], path, mempool=None)
+    return sim.processes[0], path
+
+
+def test_atomic_save_leaves_no_temp_files(tmp_path):
+    """Every checkpoint file lands via tmp + os.replace: after save()
+    returns there are no .tmp leftovers, and saving over an existing
+    checkpoint replaces it atomically (manifest last = commit point)."""
+    import os
+
+    _, path = _saved_checkpoint(tmp_path)
+    names = sorted(os.listdir(path))
+    assert not [f for f in names if f.endswith(".tmp")], names
+    assert checkpoint.MANIFEST in names
+    # overwrite in place — still no temp droppings, still restorable
+    cfg = Config(n=4)
+    sim = Simulation(cfg)
+    sim.submit_blocks(1)
+    sim.run(max_messages=200)
+    checkpoint.save(sim.processes[0], path)
+    names = sorted(os.listdir(path))
+    assert not [f for f in names if f.endswith(".tmp")], names
+    fresh = Process(Config(n=4), 0, InMemoryTransport())
+    checkpoint.restore(fresh, path)
+
+
+def test_truncated_manifest_raises_corrupt_not_garbage(tmp_path):
+    """A manifest torn mid-write (crash before rename could never produce
+    this, but disk corruption can) is classified CorruptCheckpointError —
+    the caller's signal to start empty and rejoin — and validation
+    happens BEFORE mutation: the target process is untouched."""
+    import os
+
+    _, path = _saved_checkpoint(tmp_path)
+    mpath = os.path.join(path, checkpoint.MANIFEST)
+    raw = open(mpath, "rb").read()
+    with open(mpath, "wb") as fh:
+        fh.write(raw[: len(raw) // 2])
+    fresh = Process(Config(n=4), 0, InMemoryTransport())
+    try:
+        checkpoint.restore(fresh, path)
+    except checkpoint.CorruptCheckpointError:
+        pass
+    else:
+        raise AssertionError("truncated manifest must raise corrupt")
+    assert fresh.round == 0 and fresh.dag.max_round == 0, (
+        "failed restore must not half-mutate the process"
+    )
+    assert fresh.delivered_log == []
+
+
+def test_sidecar_hash_mismatch_raises_corrupt(tmp_path):
+    """The torn window a crash CAN leave: old manifest over new sidecars
+    (or bit rot in a sidecar). The manifest's sha256 map catches it."""
+    import os
+
+    _, path = _saved_checkpoint(tmp_path)
+    vpath = os.path.join(path, checkpoint.VERTICES)
+    blob = bytearray(open(vpath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(vpath, "wb") as fh:
+        fh.write(bytes(blob))
+    fresh = Process(Config(n=4), 0, InMemoryTransport())
+    try:
+        checkpoint.restore(fresh, path)
+    except checkpoint.CorruptCheckpointError as e:
+        assert "hash mismatch" in str(e)
+    else:
+        raise AssertionError("sidecar hash mismatch must raise corrupt")
+
+
+def test_node_restores_to_empty_on_corrupt_checkpoint(tmp_path):
+    """Node-level containment: a corrupt checkpoint at boot means start
+    empty (rebuild + rejoin later), bump the checkpoint_corrupt counter,
+    and emit the event — never crash, never half-restore."""
+    import json
+    import os
+
+    from dag_rider_tpu.node import Node, generate_keys, _dump_secret_file
+    from dag_rider_tpu.utils import slog
+
+    keys_path = str(tmp_path / "keys.json")
+    _dump_secret_file(keys_path, generate_keys(4, 2, seed="ck-corrupt"))
+    ckpt_dir = str(tmp_path / "ckpt0")
+
+    def mk(events):
+        return Node(
+            {
+                "index": 0,
+                "n": 4,
+                "listen": "127.0.0.1:0",
+                "peers": {},
+                "keys": keys_path,
+                "rbc": False,
+                "verifier": "none",
+                "coin": "round_robin",
+                "checkpoint_dir": ckpt_dir,
+                "auto_propose": False,
+            },
+            log=slog.EventLog(events.append),
+        )
+
+    events: list = []
+    node = mk(events)
+    node.start()
+    node.submit(Block((b"pre-crash",)))
+    node.stop()  # writes a valid checkpoint
+    assert checkpoint.present(ckpt_dir)
+
+    # corrupt the manifest the way bit rot would
+    mpath = os.path.join(ckpt_dir, checkpoint.MANIFEST)
+    with open(mpath, "w") as fh:
+        fh.write('{"version": 1, "n": 4')  # torn JSON
+
+    events2: list = []
+    node2 = mk(events2)
+    try:
+        assert node2.process.round == 0
+        assert node2.process.dag.max_round == 0
+        snap = node2.process.metrics.snapshot()
+        assert snap.get("checkpoint_corrupt", 0) == 1, snap
+        names = [e["event"] for e in events2]
+        assert "checkpoint_corrupt" in names, names
+        assert "restored" not in names, names
+        # the rebuilt node still runs
+        node2.start()
+        node2.submit(Block((b"post-corruption",)))
+    finally:
+        node2.stop()

@@ -608,3 +608,232 @@ def test_snapshot_rpc_hardening():
     finally:
         raw.close()
         donor.close()
+
+
+# -- round 20: cluster-mode seams (Submit door, WAN faults, unicast) ----
+
+
+def test_snapshot_cached_serve_then_stale_cache_refresh():
+    """The Snapshot cache serves repeated fetches from one serialization
+    inside the TTL, then refreshes — a later fetch observes new donor
+    state, which is what lets a rejoiner chase a moving head."""
+    import time as _time
+
+    from dag_rider_tpu.transport.auth import FrameAuth
+
+    state = {"blob": b"A" * 64, "calls": 0}
+
+    def provider():
+        state["calls"] += 1
+        return state["blob"]
+
+    # frame auth so each fetcher has a relayer identity: the throttle is
+    # then per-relayer + token bucket, not the strict anonymous cap
+    auths = FrameAuth.derive(b"m", 3)
+    donor = GrpcTransport(
+        0, "127.0.0.1:0", {}, auth=auths[0],
+        snapshot_provider=provider,
+        snapshot_min_interval_s=0.3,
+    )
+    peers = {0: f"127.0.0.1:{donor.bound_port}"}
+    f1 = GrpcTransport(1, "127.0.0.1:0", dict(peers), auth=auths[1])
+    f2 = GrpcTransport(2, "127.0.0.1:0", dict(peers), auth=auths[2])
+    try:
+        assert f1.fetch_snapshot(0) == b"A" * 64
+        # donor state moves on; within the TTL the cache still serves
+        # the old blob from ONE serialization
+        state["blob"] = b"B" * 64
+        assert f2.fetch_snapshot(0) == b"A" * 64
+        assert state["calls"] == 1, "cache must serve the second fetch"
+        _time.sleep(0.35)  # TTL expiry
+        assert f1.fetch_snapshot(0) == b"B" * 64, "stale cache must refresh"
+        assert state["calls"] == 2
+    finally:
+        donor.close()
+        f1.close()
+        f2.close()
+
+
+def test_snapshot_rpc_serves_pruned_window_for_rejoin():
+    """Snapshot-while-pruned: the donor has GC'd past genesis, so a node
+    that was dead too long can only rejoin via the Snapshot RPC — fetch
+    the live window over the wire and replay it into a fresh process."""
+    from dag_rider_tpu.consensus.simulator import Simulation
+    from dag_rider_tpu.transport.memory import InMemoryTransport
+    from dag_rider_tpu.utils import checkpoint
+
+    gc_cfg = Config(n=4, coin="round_robin", propose_empty=True, gc_depth=16)
+    sim = Simulation(gc_cfg)
+    sim.submit_blocks(per_process=2)
+    for _ in range(600):
+        sim.run(max_messages=100)
+        if max(p.round for p in sim.processes) >= 50:
+            break
+    donor_proc = sim.processes[0]
+    assert donor_proc.dag.base_round > 0, "donor must have pruned"
+
+    donor = GrpcTransport(
+        0, "127.0.0.1:0", {},
+        snapshot_provider=lambda: checkpoint.snapshot_bytes(donor_proc),
+        snapshot_min_interval_s=0.01,
+    )
+    fetcher = GrpcTransport(
+        1, "127.0.0.1:0", {0: f"127.0.0.1:{donor.bound_port}"}
+    )
+    try:
+        blob = fetcher.fetch_snapshot(0)
+        assert blob, "pruned-window snapshot must be served"
+        fresh = Process(gc_cfg, 1, InMemoryTransport())
+        assert checkpoint.restore_from_snapshot(fresh, blob)
+        assert fresh.dag.base_round == donor_proc.dag.base_round
+        assert fresh.round == donor_proc.dag.max_round
+    finally:
+        donor.close()
+        fetcher.close()
+
+
+def test_submit_door_roundtrip_and_failure_containment():
+    """The client Submit front door: closed by default, serves the bound
+    sink's bytes when open, contains sink exceptions as empty (=refusal)
+    responses, and counts every call."""
+    import grpc as _grpc
+
+    node = GrpcTransport(0, "127.0.0.1:0", {})
+    chan = _grpc.insecure_channel(f"127.0.0.1:{node.bound_port}")
+    call = chan.unary_unary(
+        "/dagrider.Transport/Submit",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    try:
+        # door closed: gRPC-level unimplemented, not a crash
+        with pytest.raises(_grpc.RpcError):
+            call(b"{}", timeout=5)
+
+        seen = []
+
+        def sink(req: bytes) -> bytes:
+            seen.append(req)
+            if req == b"boom":
+                raise ValueError("malformed frame")
+            return b"ok:" + req
+
+        node.set_submit_sink(sink)
+        assert bytes(call(b"hello", timeout=5)) == b"ok:hello"
+        assert bytes(call(b"boom", timeout=5)) == b"", (
+            "sink exception must become an empty refusal"
+        )
+        assert seen == [b"hello", b"boom"]
+        snap = node.metrics.snapshot()
+        assert snap.get("net_client_submits", 0) == 2, snap
+        # door closes again: refuse without invoking the old sink
+        node.set_submit_sink(None)
+        with pytest.raises(_grpc.RpcError):
+            call(b"late", timeout=5)
+        assert seen == [b"hello", b"boom"]
+    finally:
+        chan.close()
+        node.close()
+
+
+def test_enqueue_is_unicast_but_protocol_gate_opts_out():
+    """GrpcTransport.enqueue sends to exactly one peer (the Byzantine
+    per-destination seam), but resolve_unicast must NOT route honest
+    protocol traffic through it — single-copy sync over a lossy socket
+    loses whole patience windows during recovery."""
+    import time as _time
+
+    from dag_rider_tpu.transport.base import resolve_unicast
+
+    transports = [GrpcTransport(i, "127.0.0.1:0", {}) for i in range(3)]
+    addrs = {
+        i: f"127.0.0.1:{t.bound_port}" for i, t in enumerate(transports)
+    }
+    for t in transports:
+        t._peers.update(addrs)
+    got = {i: [] for i in range(3)}
+    for i, t in enumerate(transports):
+        t.subscribe(i, got[i].append)
+    try:
+        # honest routing refuses the unicast seam on this transport
+        assert resolve_unicast(transports[0]) is None
+        assert GrpcTransport.protocol_unicast is False
+        # ...but the seam itself works, one destination only
+        v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+        transports[0].enqueue(1, BroadcastMessage(vertex=v, round=1, sender=0))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and not got[1]:
+            _pump_all(transports, rounds=1)
+            _time.sleep(0.01)
+        assert got[1] and got[1][0].vertex == v
+        assert not got[2], "enqueue must not broadcast"
+        # the adversary seam deliberately ignores the honest gate
+        from dag_rider_tpu.consensus.adversary import _resolve_enqueue
+
+        assert _resolve_enqueue(transports[0]) is not None
+    finally:
+        for t in transports:
+            t.close()
+
+
+def test_wan_fault_drop_is_not_charged_to_failure_detector():
+    """A WAN drop is weather, not a dead peer: the send never happens,
+    net_wan_drops counts it, and the failure detector's consecutive-
+    failure ledger stays clean."""
+    from dag_rider_tpu.transport.net import WanFault
+
+    sink = GrpcTransport(1, "127.0.0.1:0", {})
+    src = GrpcTransport(
+        0,
+        "127.0.0.1:0",
+        {1: f"127.0.0.1:{sink.bound_port}"},
+        send_fault=WanFault(seed=1, drop=1.0),
+    )
+    got = []
+    sink.subscribe(1, got.append)
+    try:
+        v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+        for _ in range(5):
+            src.broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+        snap = src.metrics.snapshot()
+        assert snap.get("net_wan_drops", 0) == 5, snap
+        assert snap.get("net_sends", 0) == 0, "dropped before the socket"
+        assert src._consec_fail.get(1, 0) == 0, (
+            "drops must not charge the failure detector"
+        )
+        sink.pump(16)
+        assert not got
+    finally:
+        src.close()
+        sink.close()
+
+
+def test_wan_fault_delay_still_delivers():
+    """Delayed sends are late, not lost: the message arrives after the
+    seeded hold and net_wan_delays records the weather."""
+    import time as _time
+
+    from dag_rider_tpu.transport.net import WanFault
+
+    sink = GrpcTransport(1, "127.0.0.1:0", {})
+    src = GrpcTransport(
+        0,
+        "127.0.0.1:0",
+        {1: f"127.0.0.1:{sink.bound_port}"},
+        send_fault=WanFault(seed=2, delay_ms=(5.0, 20.0), delay_rate=1.0),
+    )
+    got = []
+    sink.subscribe(1, got.append)
+    try:
+        v = Vertex(id=VertexID(1, 0), strong_edges=(VertexID(0, 1),))
+        src.broadcast(BroadcastMessage(vertex=v, round=1, sender=0))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and not got:
+            sink.pump(16)
+            _time.sleep(0.01)
+        assert got and got[0].vertex == v
+        snap = src.metrics.snapshot()
+        assert snap.get("net_wan_delays", 0) == 1, snap
+    finally:
+        src.close()
+        sink.close()
