@@ -1819,6 +1819,387 @@ def _cluster_e2e_rung(
     return entry
 
 
+def _epoch_join_cell(
+    n: int,
+    load_s: float,
+    rate: float,
+    seed: int,
+    boot_s: float,
+    catchup_s: float = 120.0,
+) -> dict:
+    """Mid-run join from a span-attested snapshot, as real OS
+    processes: n-1 nodes boot with epochs + span certs on, a rotate op
+    is committed through the wire Submit door, and the last node starts
+    only after the survivors have GC'd past its genesis — forcing a
+    state transfer it can ONLY satisfy from the attested snapshot."""
+    import math
+    import shutil
+    import tempfile
+    import threading as _th
+
+    from dag_rider_tpu.cluster import audit as _caudit
+    from dag_rider_tpu.cluster import client as _cclient
+    from dag_rider_tpu.cluster.directory import build_cluster
+    from dag_rider_tpu.cluster.supervisor import ClusterSupervisor
+    from dag_rider_tpu.core.codec import encode_epoch_op
+    from dag_rider_tpu.core.types import EpochOp
+
+    # k_span=2, NOT 4: round r's cert aggregator is r % n, so while the
+    # joiner is absent every n-th round degrades to per-vertex verifies.
+    # A span window aligned with that stride (k=n=4) always contains a
+    # degraded round and never settles; k=2 keeps every other window
+    # settling, so the donor has a live span chain to attest with
+    k_span = 2
+    gc_depth = 16
+    root = tempfile.mkdtemp(prefix="dagrider-bench-epochjoin-")
+    spec = build_cluster(
+        root,
+        n,
+        transport="uds",
+        seed=seed,
+        gc_depth=gc_depth,
+        # patience is quiescent pump ticks (~ms): socket-distributed
+        # share aggregation needs seconds, not the in-process default
+        node_overrides={"cert": "agg", "cert_patience": 2000},
+    )
+    sup = ClusterSupervisor(
+        spec,
+        env={
+            "DAGRIDER_EPOCH": "1",
+            "DAGRIDER_EPOCH_WAVES": "4",
+            "DAGRIDER_CERT_SPAN": str(k_span),
+            # share signing dominates the cert path at wall-clock round
+            # rates; the compiled lane keeps certs (and therefore
+            # spans) assembling at socket speed
+            "DAGRIDER_CERT_SIGN": "native",
+        },
+    )
+    joiner = n - 1
+    for i in range(n - 1):
+        sup.start(i)
+    not_ready = sup.wait_ready(boot_s)
+    if not_ready:
+        sup.stop_all()
+        raise AssertionError(
+            f"epoch join: nodes {not_ready} not ready in {boot_s}s "
+            f"(workspace kept at {root})"
+        )
+    # commit one rotate op through the wire front door, and ledger it so
+    # the audit's zero-loss accounting covers control traffic too
+    op = encode_epoch_op(EpochOp("rotate", joiner, seed, b""))
+    cli = _cclient.SubmitClient(spec)
+    verdict = None
+    for _ in range(50):
+        verdict = cli.submit(0, "epochctl", op)
+        if verdict and (verdict["accepted"] or verdict["deduped"]):
+            break
+        _th.Event().wait(0.1)
+    cli.close()
+    if not verdict or not (verdict["accepted"] or verdict["deduped"]):
+        sup.stop_all()
+        raise AssertionError(f"epoch join: rotate op never acked: {verdict}")
+    with open(spec.accepted_log, "a", buffering=1) as fh:
+        fh.write(
+            json.dumps(
+                {
+                    "tx": op.hex(),
+                    "ts": time.time(),
+                    "node": verdict["node"],
+                    "client": "epochctl",
+                }
+            )
+            + "\n"
+        )
+    load: dict = {}
+    loader = _th.Thread(
+        target=lambda: load.update(
+            _cclient.drive_load(spec, duration_s=load_s, rate=rate, seed=seed)
+        ),
+        daemon=True,
+    )
+    loader.start()
+    # start the joiner only once the survivors' committed frontier is
+    # past gc_depth: its genesis rounds are pruned everywhere, so plain
+    # window sync CANNOT answer — only the attested snapshot can. The
+    # cert path runs at pairing speed, so rounds take ~1s of wall clock
+    # here; the survivors keep advancing (empty-proposing) after the
+    # load drains, hence the window is much wider than load_s.
+    deadline = time.monotonic() + load_s + 90.0
+    survivor_round = 0
+    while time.monotonic() < deadline:
+        log = _caudit.read_delivery_log(spec.nodes[0].delivery_log)
+        survivor_round = max((rec["r"] for rec in log), default=0)
+        if survivor_round > gc_depth + 8:
+            break
+        _th.Event().wait(0.25)
+    if survivor_round <= gc_depth + 8:
+        sup.stop_all()
+        raise AssertionError(
+            f"epoch join: survivors never committed past the joiner "
+            f"horizon (round {survivor_round} <= {gc_depth + 8}; "
+            f"workspace kept at {root})"
+        )
+    sup.start(joiner)
+    loader.join(timeout=load_s + 60)
+    not_ready = sup.wait_ready(boot_s)
+    if not_ready:
+        sup.stop_all()
+        raise AssertionError(
+            f"epoch join: joiner never ready (workspace kept at {root})"
+        )
+    # the survivors stay live (empty-proposing) after the load drains:
+    # hold the cluster up until the joiner has COMMITTED past the
+    # frontier it joined behind — boot (~10s of interpreter + jax),
+    # nack accrual, the snapshot fetch/restore, and then a full wave
+    # past the restored round all happen inside this window, at ~1s
+    # per round of cert-path wall clock
+    catch_deadline = time.monotonic() + catchup_s
+    while time.monotonic() < catch_deadline:
+        jlog = _caudit.read_delivery_log(spec.nodes[joiner].delivery_log)
+        if jlog and max(rec["r"] for rec in jlog) >= survivor_round:
+            break
+        _th.Event().wait(0.5)
+    _th.Event().wait(1.5)  # settle: let in-flight waves commit
+    sup.stop_all()
+    report = _caudit.audit_cluster(spec, restarted=[joiner])
+    finals = {
+        i: _caudit.read_final(spec.nodes[i].final_report) or {}
+        for i in range(n)
+    }
+    epochs = {
+        i: int(finals[i].get("metrics", {}).get("epoch_current", 0))
+        for i in range(n)
+    }
+    jm = finals[joiner].get("metrics", {})
+    spans_verified = int(jm.get("snapshot_spans_verified", 0))
+    pairing = int(jm.get("snapshot_pairing_checks", 0))
+    join_round = int(finals[joiner].get("round", 0))
+    budget = math.ceil(max(1, join_round) / k_span)
+    entry = {
+        "nodes": n,
+        "survivor_round_at_join": survivor_round,
+        "load": load,
+        "ok": report["ok"],
+        "violations": report["violations"],
+        "accepted_tx": report["accepted_tx"],
+        "delivered_tx": report["delivered_tx"],
+        "lost_tx": report["lost_tx"],
+        "duplicate_tx": report["duplicate_tx"],
+        "joiner_delivered": report["log_lengths"].get(joiner, 0),
+        "epochs": epochs,
+        "snapshot_spans_verified": spans_verified,
+        "snapshot_pairing_checks": pairing,
+        "pairing_budget": budget,
+    }
+    ok = (
+        report["ok"]
+        and entry["joiner_delivered"] > 0
+        and spans_verified > 0
+        and pairing <= budget
+        and min(epochs.values()) >= 1
+        and len(set(epochs.values())) == 1
+    )
+    if ok:
+        shutil.rmtree(root, ignore_errors=True)
+    else:
+        entry["workspace"] = root  # kept for post-mortem
+    if not report["ok"]:
+        raise AssertionError(f"epoch join audit failed: {report['violations']}")
+    if entry["joiner_delivered"] <= 0:
+        raise AssertionError(f"epoch join: joiner committed nothing: {entry}")
+    if spans_verified <= 0:
+        raise AssertionError(
+            f"epoch join: joiner never verified a span — state transfer "
+            f"took the unattested path: {entry}"
+        )
+    if pairing > budget:
+        raise AssertionError(
+            f"epoch join: {pairing} pairing checks over the "
+            f"ceil(round/k_span)={budget} budget: {entry}"
+        )
+    if min(epochs.values()) < 1 or len(set(epochs.values())) != 1:
+        raise AssertionError(f"epoch join: epochs disagree: {epochs}")
+    return entry
+
+
+def _epoch_rotate_ab_cell(seed: int) -> dict:
+    """Key-rotation acceptance, in-process with REAL per-process
+    threshold coins (independent share books, shared initial dealer
+    keys): an epoch boundary rotates every share key in lockstep, the
+    cluster keeps deciding waves on the rotated keys, and the committed
+    prefix up to the boundary is byte-identical to a static-membership
+    run fed the same transactions — including the control op itself
+    (zero lost acked txs)."""
+    from dag_rider_tpu import Config
+    from dag_rider_tpu.consensus import Simulation
+    from dag_rider_tpu.consensus.coin import ThresholdCoin
+    from dag_rider_tpu.core import codec
+    from dag_rider_tpu.core.types import Block, EpochOp
+    from dag_rider_tpu.crypto import threshold as th
+
+    n, wl = 4, 4
+    keys = th.ThresholdKeys.generate(n, (n - 1) // 3 + 1, seed=b"bench-ab")
+    op = codec.encode_epoch_op(EpochOp("rotate", 0, seed, b""))
+
+    def run(epoch_on: bool) -> Simulation:
+        cfg = Config(
+            n=n,
+            coin="threshold_bls",
+            propose_empty=True,
+            epoch=epoch_on,
+            epoch_waves=4,
+            epoch_rotate="seed",
+        )
+        sim = Simulation(
+            cfg, coin_factory=lambda i: ThresholdCoin(keys, i, n)
+        )
+        sim.submit_blocks(per_process=2)
+        sim.processes[0].submit(Block((op,)))
+        for _ in range(900):
+            done = min(p.decided_wave for p in sim.processes) >= 5 and (
+                not epoch_on
+                or min(p.epoch_mgr.epoch for p in sim.processes) >= 1
+            )
+            if done:
+                break
+            sim.run(max_messages=300)
+        else:
+            raise AssertionError(
+                f"epoch rotate_ab: run(epoch={epoch_on}) never settled"
+            )
+        sim.check_agreement()
+        return sim
+
+    rot = run(True)
+    static = run(False)
+    rotations = min(
+        p.metrics.counters["epoch_rotations"] for p in rot.processes
+    )
+    if rotations < 1:
+        raise AssertionError("epoch rotate_ab: a process never rotated keys")
+    cut = rot.processes[0].epoch_mgr.history[-1].boundary_wave * wl
+
+    def prefix(sim):
+        return [
+            (v.id.round, v.id.source, v.digest())
+            for v in sim.deliveries[0]
+            if v.id.round <= cut
+        ]
+
+    if prefix(rot) != prefix(static):
+        raise AssertionError(
+            "epoch rotate_ab: pre-boundary prefix diverges from the "
+            "static-membership run"
+        )
+    delivered = {
+        tx
+        for v in rot.deliveries[0]
+        if v.block is not None
+        for tx in v.block.transactions
+    }
+    if op not in delivered:
+        raise AssertionError("epoch rotate_ab: control op lost")
+    return {
+        "boundary_wave": cut // wl,
+        "decided_waves": min(p.decided_wave for p in rot.processes),
+        "rotations_min": rotations,
+        "prefix_identical": True,
+        "prefix_len": len(prefix(rot)),
+        "control_op_committed": True,
+    }
+
+
+def _epoch_flatness_cell(seed: int) -> dict:
+    """Three sequenced epochs under GC: vertices_live_max must settle —
+    the retained window is bounded by waves+depth, not by history."""
+    from dag_rider_tpu import Config
+    from dag_rider_tpu.consensus import Simulation
+    from dag_rider_tpu.core import codec
+    from dag_rider_tpu.core.types import Block, EpochOp
+
+    cfg = Config(
+        n=4,
+        coin="round_robin",
+        propose_empty=True,
+        epoch=True,
+        epoch_waves=2,
+        gc_depth=16,
+        epoch_gc=0,
+    )
+    sim = Simulation(cfg)
+    sim.submit_blocks(per_process=2)
+    marks = []
+    for k in range(3):
+        sim.processes[0].submit(
+            Block((codec.encode_epoch_op(EpochOp("rotate", 0, seed + k, b"")),))
+        )
+        for _ in range(900):
+            if min(p.epoch_mgr.epoch for p in sim.processes) >= k + 1:
+                break
+            sim.run(max_messages=300)
+        else:
+            raise AssertionError(f"epoch flatness: epoch {k + 1} never settled")
+        marks.append(
+            max(
+                p.metrics.counters["vertices_live_max"]
+                for p in sim.processes
+            )
+        )
+    if marks[-1] > marks[0] + cfg.n * cfg.wave_length:
+        raise AssertionError(
+            f"epoch flatness: vertices_live_max grew across epochs: {marks}"
+        )
+    bound = cfg.n * (
+        cfg.epoch_waves * cfg.wave_length
+        + cfg.gc_depth
+        + 4 * cfg.wave_length
+    )
+    if marks[-1] > bound:
+        raise AssertionError(
+            f"epoch flatness: high-water {marks[-1]} over bound {bound}"
+        )
+    return {
+        "epochs": 3,
+        "vertices_live_max_per_epoch": marks,
+        "bound": bound,
+        "flat": True,
+    }
+
+
+def _epoch_rung(
+    n: int = 4,
+    load_s: float = 8.0,
+    rate: float = 250.0,
+    seed: int = 7,
+    boot_s: float = 20.0,
+    catchup_s: float = 120.0,
+    cells: tuple = ("join", "rotate_ab", "flatness"),
+) -> dict:
+    """Ladder rung (ISSUE 20): epoch reconfiguration + span-attested
+    snapshot sync. Three cells, each RAISING on a missed gate:
+
+    - **join**: a late node catches up mid-load from a span-attested
+      snapshot within <= ceil(round / k_span) pairing checks, its
+      commit log embeds byte-identically into the survivor order, and
+      every node lands in the same epoch >= 1.
+    - **rotate_ab**: an epoch boundary rotates real threshold-coin
+      share keys in lockstep with zero lost acked txs and a pre-
+      boundary prefix byte-identical to a static-membership run.
+    - **flatness**: vertices_live_max stays flat across >= 3 settled
+      epochs — the GC floor advances with the boundary.
+    """
+    entry: dict = {}
+    if "join" in cells:
+        entry["join"] = _epoch_join_cell(
+            n, load_s, rate, seed, boot_s, catchup_s=catchup_s
+        )
+    if "rotate_ab" in cells:
+        entry["rotate_ab"] = _epoch_rotate_ab_cell(seed)
+    if "flatness" in cells:
+        entry["flatness"] = _epoch_flatness_cell(seed)
+    return entry
+
+
 def _measure() -> None:
     budget = float(os.environ.get("DAGRIDER_BENCH_SECONDS", "300"))
     t_start = time.monotonic()
@@ -2784,6 +3165,66 @@ def _measure() -> None:
             _mark(f"ladder cluster_e2e FAILED: {e!r}")
     else:
         _mark(f"skipping ladder cluster_e2e (left {left():.0f}s)")
+
+    # -- ladder rung (ISSUE 20): epoch reconfiguration + span-attested
+    # snapshot sync. Three gated cells — a real OS-process cluster where
+    # a late node joins mid-load from a span-attested snapshot (pairing
+    # budget + embedding + unanimous epoch), a threshold-coin rotation
+    # A/B (byte-identical pre-boundary prefix, zero lost acked txs) and
+    # a 3-epoch GC flatness check — the rung RAISES on any missed gate.
+    ep_s = float(os.environ.get("DAGRIDER_BENCH_EPOCH_S", "180"))
+    ep_rate = float(os.environ.get("DAGRIDER_BENCH_EPOCH_RATE", "250"))
+    if ep_s > 0 and left() > ep_s + 30:
+        _mark(
+            "ladder epoch: mid-load join from span-attested snapshot "
+            "+ key-rotation A/B + GC flatness across 3 epochs"
+        )
+        try:
+            t_rung = time.monotonic()
+            entry = _epoch_rung(
+                rate=ep_rate, catchup_s=max(60.0, ep_s - 60)
+            )
+            entry["rung_seconds"] = round(time.monotonic() - t_rung, 1)
+            result["ladder"]["epoch"] = entry
+            j = entry["join"]
+            _mark(
+                f"ladder epoch: joiner verified "
+                f"{j['snapshot_spans_verified']} spans in "
+                f"{j['snapshot_pairing_checks']} pairings "
+                f"(budget {j['pairing_budget']}), epochs "
+                f"{sorted(set(j['epochs'].values()))}, "
+                f"lost={j['lost_tx']}; rotate_ab boundary wave "
+                f"{entry['rotate_ab']['boundary_wave']} prefix_identical="
+                f"{entry['rotate_ab']['prefix_identical']}; flatness "
+                f"{entry['flatness']['vertices_live_max_per_epoch']}"
+            )
+            emit()
+            import datetime as _dt
+
+            from dag_rider_tpu import config as _cfg
+
+            out_path = os.path.join(
+                _REPO, _cfg.env_str("DAGRIDER_EPOCH_OUT")
+            )
+            with open(out_path, "w") as fh:
+                json.dump(
+                    {
+                        "schema": "dag-rider-tpu/bench-epoch/v1",
+                        "captured": _dt.datetime.now().isoformat(
+                            timespec="seconds"
+                        ),
+                        "backend": result.get("backend", "cpu"),
+                        "epoch": entry,
+                    },
+                    fh,
+                    indent=1,
+                )
+                fh.write("\n")
+            _mark(f"ladder epoch: wrote {out_path}")
+        except Exception as e:  # noqa: BLE001 — rung is best-effort
+            _mark(f"ladder epoch FAILED: {e!r}")
+    else:
+        _mark(f"skipping ladder epoch (left {left():.0f}s)")
 
     # -- ladder rung: Byzantine adversary x WAN suite at committee scale.
     # Every adversary class from consensus/adversary.py drives f=10 of

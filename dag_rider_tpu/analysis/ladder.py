@@ -120,6 +120,14 @@ LADDERS: Tuple[Ladder, ...] = (
         _P + "_submit_via_lanes",
         _P + "_submit_inline",
     ),
+    # epoch reconfiguration: delivery-time boundary scan vs the static-
+    # membership no-op seam (epoch off = fixed validator set forever)
+    Ladder(
+        "DAGRIDER_EPOCH",
+        _P + "_epoch_note_delivery",
+        _P + "_epoch_scan_chunk",
+        _P + "_epoch_static",
+    ),
 )
 
 

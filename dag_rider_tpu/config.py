@@ -164,6 +164,26 @@ _register("DAGRIDER_CLUSTER_KEEP", "flag", False,
           "dumps) after a run instead of deleting it")
 _register("DAGRIDER_CLUSTER_OUT", "str", "BENCH_r20.json",
           "cluster-e2e ladder bench output path")
+_register("DAGRIDER_EPOCH", "flag", False,
+          "epoch reconfiguration: validator-set changes ordered through "
+          "consensus as control txs, taking effect at deterministic "
+          "wave boundaries (ISSUE 20)")
+_register("DAGRIDER_EPOCH_WAVES", "int", 8,
+          "epoch boundary interval in waves: a committed reconfiguration "
+          "control tx takes effect at the next multiple of this many "
+          "waves", minimum=1)
+_register("DAGRIDER_EPOCH_GC", "int", 0,
+          "extra epoch GC depth in rounds kept past the committed "
+          "frontier when an epoch settles (0 = reuse gc_depth)",
+          minimum=0)
+_register("DAGRIDER_EPOCH_ROTATE", "choice", "seed",
+          "threshold-key rotation mode at epoch boundaries: seed = "
+          "deterministic seeded dealer (every node derives identical "
+          "keys from the committed transcript), dkg = full joint-Feldman "
+          "resharing over crypto/dkg.py, none = epoch bump only",
+          choices=("seed", "dkg", "none"))
+_register("DAGRIDER_EPOCH_OUT", "str", "BENCH_r21.json",
+          "epoch ladder bench output path")
 
 
 def _raw(name: str) -> str:
@@ -391,6 +411,28 @@ class Config:
     #: minimum encoded-block bytes before a block rides a lane
     #: (None -> DAGRIDER_LANE_BATCH_BYTES); smaller blocks stay inline
     lane_batch_bytes: Optional[int] = None
+    # Epoch reconfiguration (ISSUE 20): when on, magic-prefixed control
+    # transactions committed through the ordinary total order schedule
+    # validator-set changes (join/leave/key-rotation) that take effect
+    # at the next epoch boundary — a wave number every process derives
+    # identically from the ordered log — rotating the threshold coin
+    # keys and advancing an epoch id carried in the wire form (stale
+    # pre-rotation messages are rejected at the receive seam). Off keeps
+    # the static-membership oracle. None resolves from DAGRIDER_EPOCH;
+    # explicit beats env, like pump/cert/lanes.
+    epoch: Optional[bool] = None
+    #: boundary interval in waves (None -> DAGRIDER_EPOCH_WAVES): a
+    #: control tx committed in wave w activates at the next multiple
+    #: of epoch_waves strictly after w
+    epoch_waves: Optional[int] = None
+    #: extra GC depth in rounds kept past a settled epoch's frontier
+    #: (None -> DAGRIDER_EPOCH_GC; 0 = reuse gc_depth)
+    epoch_gc: Optional[int] = None
+    #: key-rotation mode at boundaries (None -> DAGRIDER_EPOCH_ROTATE):
+    #: "seed" derives the next ThresholdKeys from a deterministic
+    #: dealer seeded by the committed transcript, "dkg" runs the full
+    #: joint-Feldman resharing, "none" bumps the epoch id only
+    epoch_rotate: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.n < 1:
@@ -448,6 +490,29 @@ class Config:
         if self.lane_batch_bytes < 1:
             raise ValueError(
                 f"lane_batch_bytes must be >= 1, got {self.lane_batch_bytes}"
+            )
+        if self.epoch is None:
+            object.__setattr__(self, "epoch", env_flag("DAGRIDER_EPOCH"))
+        if self.epoch_waves is None:
+            object.__setattr__(
+                self, "epoch_waves", env_int("DAGRIDER_EPOCH_WAVES")
+            )
+        if self.epoch_waves < 1:
+            raise ValueError(
+                f"epoch_waves must be >= 1, got {self.epoch_waves}"
+            )
+        if self.epoch_gc is None:
+            object.__setattr__(self, "epoch_gc", env_int("DAGRIDER_EPOCH_GC"))
+        if self.epoch_gc < 0:
+            raise ValueError(f"epoch_gc must be >= 0, got {self.epoch_gc}")
+        if self.epoch_rotate is None:
+            object.__setattr__(
+                self, "epoch_rotate", env_choice("DAGRIDER_EPOCH_ROTATE")
+            )
+        if self.epoch_rotate not in ("seed", "dkg", "none"):
+            raise ValueError(
+                f'epoch_rotate must be "seed", "dkg" or "none", '
+                f"got {self.epoch_rotate!r}"
             )
         if self.f is None:
             object.__setattr__(self, "f", (self.n - 1) // 3)

@@ -52,6 +52,7 @@ ADVERSARIES = (
     "garbage_coin",
     "lane_withhold",
     "lane_garbage_ack",
+    "stale_epoch",
 )
 
 
@@ -332,6 +333,44 @@ class LaneGarbageAckBehavior(ByzantineBehavior):
         coord._make_ack = garbled  # instance attr shadows the method
 
 
+class StaleEpochBehavior(ByzantineBehavior):
+    """Pre-rotation replay (ISSUE 20): every proposal is disseminated
+    honestly, tagged with the sender's current epoch, and recorded;
+    once the host crosses an epoch boundary the recorded pre-boundary
+    traffic is re-broadcast verbatim — old epoch tag and all. Honest
+    receivers must drop each replay at the wire stale gate
+    (``epoch_stale_rejected``) before spending signature or RBC work on
+    it; a replayed coin share from the pre-rotation key set must never
+    enter a post-rotation share book. With the epoch path off the
+    behavior degrades to honest and its stats prove vacuity."""
+
+    name = "stale_epoch"
+    KEEP = 32  # recorded messages retained
+    REPLAY = 4  # stale replays injected per fresh proposal
+
+    def __init__(self, seed: int = 0) -> None:
+        super().__init__(seed)
+        self._recorded: list = []  # (epoch_at_send, BroadcastMessage)
+
+    def disseminate(self, proc: Process, v: Vertex) -> None:
+        mgr = proc.epoch_mgr
+        cur = mgr.epoch if mgr is not None else 0
+        msg = BroadcastMessage(
+            vertex=v, round=v.round, sender=v.id.source, epoch=cur
+        )
+        proc.transport.broadcast(msg)
+        if mgr is None:
+            return
+        stale = [m for e, m in self._recorded if e < cur]
+        self.rng.shuffle(stale)
+        for m in stale[: self.REPLAY]:
+            proc.transport.broadcast(m)
+            self.stats["extra_sent"] += 1
+        self._recorded.append((cur, msg))
+        if len(self._recorded) > self.KEEP:
+            self._recorded.pop(0)
+
+
 def make_behavior(kind: str, seed: int = 0) -> ByzantineBehavior:
     """Factory over :data:`ADVERSARIES` (scenario runner / bench rung)."""
     if kind == "equivocate":
@@ -348,6 +387,8 @@ def make_behavior(kind: str, seed: int = 0) -> ByzantineBehavior:
         return LaneWithholdBehavior(seed)
     if kind == "lane_garbage_ack":
         return LaneGarbageAckBehavior(seed)
+    if kind == "stale_epoch":
+        return StaleEpochBehavior(seed)
     raise ValueError(f"unknown adversary {kind!r} (choose from {ADVERSARIES})")
 
 

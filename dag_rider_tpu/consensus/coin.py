@@ -53,6 +53,12 @@ class CommonCoin(abc.ABC):
         coin's books follow the same bounded window as the DAG and the
         RBC stage."""
 
+    def rotate(self, keys, from_wave: int) -> None:
+        """Install rotated threshold keys effective for waves >=
+        ``from_wave`` (ISSUE 20 epoch boundary) — no-op for keyless
+        coins, whose leader schedule is wave-indexed and survives any
+        membership epoch unchanged."""
+
 
 class FixedCoin(CommonCoin):
     """Constant leader — reference-stub semantics (``process.go:390-392``),
@@ -102,6 +108,12 @@ class ThresholdCoin(CommonCoin):
         self.index = index
         self.n = n
         self._msm = msm
+        #: epoch key schedule (ISSUE 20): (first_wave, keys) entries,
+        #: ascending. ``keys`` above always aliases the newest entry;
+        #: :meth:`_keys_for` resolves the keys a given wave signs and
+        #: verifies under, so a boundary rotation never invalidates
+        #: shares already piggybacked for pre-boundary waves.
+        self._schedule: list = [(1, keys)]
         self._shares: dict = {}
         self._sigma: dict = {}
         self._tried_at: dict = {}
@@ -112,8 +124,40 @@ class ThresholdCoin(CommonCoin):
         #: just its first increment
         self.filtered = 0
 
+    def _keys_for(self, wave: int):
+        """The key set wave ``wave`` operates under: the newest schedule
+        entry whose first_wave is <= wave."""
+        keys = self._schedule[0][1]
+        for first, k in self._schedule:
+            if first > wave:
+                break
+            keys = k
+        return keys
+
+    def rotate(self, keys, from_wave: int) -> None:
+        """Install rotated keys for waves >= ``from_wave`` and make them
+        the default for share signing. Aggregation state for pending
+        waves is reset — any share that arrived early for a post-boundary
+        wave must be re-judged under the keys that wave now verifies
+        against (stale-epoch shares fail the pairing filter and are
+        discarded, not trusted)."""
+        if self._schedule[-1][0] >= from_wave:
+            self._schedule = [
+                (f, k) for f, k in self._schedule if f < from_wave
+            ]
+        self._schedule.append((from_wave, keys))
+        self.keys = keys
+        for w in [w for w in self._sigma if w >= from_wave]:
+            del self._sigma[w]
+        for w in [w for w in self._tried_at if w >= from_wave]:
+            del self._tried_at[w]
+
     def my_share(self, wave: int):
-        return self._th.sign_share(self.keys.share_sks[self.index], wave)
+        keys = self._keys_for(wave)
+        sk = keys.share_sks[self.index]
+        if sk is None:
+            return None
+        return self._th.sign_share(sk, wave)
 
     def observe_share(self, wave: int, source: int, share: bytes) -> None:
         if not isinstance(share, (bytes, bytearray)) or len(share) != 48:
@@ -123,16 +167,17 @@ class ThresholdCoin(CommonCoin):
     def _try_aggregate(self, wave: int) -> None:
         if wave in self._sigma:
             return
+        keys = self._keys_for(wave)
         shares = self._shares.get(wave, {})
-        if len(shares) < self.keys.threshold:
+        if len(shares) < keys.threshold:
             return
         have = frozenset(shares)
         if self._tried_at.get(wave) == have:
             return  # no new shares since the last failed attempt
         self._tried_at[wave] = have
-        sigma = self._th.aggregate(shares, self.keys.threshold, msm=self._msm)
+        sigma = self._th.aggregate(shares, keys.threshold, msm=self._msm)
         if sigma is not None and self._th.verify_group(
-            self.keys.group_pk, wave, sigma
+            keys.group_pk, wave, sigma
         ):
             self._sigma[wave] = sigma
             return
@@ -140,12 +185,12 @@ class ThresholdCoin(CommonCoin):
         # GT-defect localization — one pairing product for the honest
         # remainder instead of one pairing per share).
         good = self._th.batch_verify_shares(
-            self.keys.share_pks, wave, shares, msm=self._msm
+            keys.share_pks, wave, shares, msm=self._msm
         )
         self.filtered += len(shares) - len(good)
         self._shares[wave] = good
-        if len(good) >= self.keys.threshold:
-            sigma = self._th.aggregate(good, self.keys.threshold, msm=self._msm)
+        if len(good) >= keys.threshold:
+            sigma = self._th.aggregate(good, keys.threshold, msm=self._msm)
             if sigma is not None:
                 self._sigma[wave] = sigma
 
@@ -156,6 +201,11 @@ class ThresholdCoin(CommonCoin):
         for d in (self._shares, self._sigma, self._tried_at):
             for w in [w for w in d if w < wave]:
                 del d[w]
+        # retire key-schedule entries wholly below the floor, keeping
+        # the entry in force AT the floor wave (still needed to verify
+        # shares for every surviving wave)
+        while len(self._schedule) > 1 and self._schedule[1][0] <= wave:
+            self._schedule.pop(0)
 
     def ready(self, wave: int) -> bool:
         self._try_aggregate(wave)

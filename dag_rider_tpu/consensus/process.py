@@ -43,14 +43,21 @@ import numpy as np
 from dag_rider_tpu.config import Config
 from dag_rider_tpu.consensus.coin import CommonCoin, FixedCoin, RoundRobinCoin
 from dag_rider_tpu.consensus.dag_state import DagState
+from dag_rider_tpu.core.codec import EPOCH_MAGIC, encode_epoch_op
 from dag_rider_tpu.core.stack import Stack
 from dag_rider_tpu.core.types import (
     Block,
     BroadcastMessage,
+    EpochOp,
     RoundCertificate,
     SpanCertificate,
     Vertex,
     VertexID,
+)
+from dag_rider_tpu.epoch.manager import (
+    EpochManager,
+    EpochTransition,
+    derive_epoch_keys,
 )
 from dag_rider_tpu.obs import block_key
 from dag_rider_tpu.transport.base import Transport, resolve_unicast
@@ -255,11 +262,38 @@ class Process:
         #: spans received but not yet applied (same deferred application
         #: discipline as _pending_certs)
         self._pending_spans: List[SpanCertificate] = []
+        # -- epoch reconfiguration (ISSUE 20) --------------------------
+        #: None = static membership (the oracle path). NAMING NOTE: the
+        #: span-certificate books above use "epoch" for their k-round
+        #: aggregation groups — unrelated. Everything reconfiguration
+        #: lives behind epoch_mgr / the ``epoch_*`` method prefix.
+        self.epoch_mgr = (
+            EpochManager(cfg.epoch_waves) if cfg.epoch else None
+        )
+        #: pending epoch-boundary GC floor (applied at the next
+        #: maybe_prune, never mid-ordering — see _epoch_advance)
+        self._epoch_gc_floor: Optional[int] = None
         self.metrics = Metrics()
         if self._cert:
             self.metrics.counters["cert_path_enabled"] = 1
             if self._span:
                 self.metrics.counters["span_path_enabled"] = 1
+        if self.epoch_mgr is not None:
+            # visible-at-zero gauges, same discipline as the eager path:
+            # "epoch 0, nothing rejected" must be distinguishable from
+            # "epoch path absent" in snapshots
+            self.metrics.counters["epoch_path_enabled"] = 1
+            self.metrics.counters["epoch_current"] = 0
+            self.metrics.counters["epoch_stale_rejected"] = 0
+            #: high-water mark of live (unpruned) vertices — the
+            #: flatness witness for epoch GC (ISSUE 20 satellite 2)
+            self.metrics.counters["vertices_live_max"] = 0
+        #: verified span certificates kept for snapshot attestation
+        #: (ISSUE 20): span-epoch -> SpanCertificate, populated on both
+        #: the aggregator and receiver sides, pruned with the GC floor
+        #: but only below the snapshot base (the attestation must cover
+        #: the window a joiner restores).
+        self._span_chain: Dict[int, SpanCertificate] = {}
         self._started = False
         # Burst delivery (the north-star batching shape): when True,
         # ``on_message`` only queues — the driver (Simulation pump / net
@@ -387,6 +421,15 @@ class Process:
         exactly the round the inline block would have taken, which is
         what makes lanes-vs-inline byte-identity provable. Blocks the
         lane refuses (undersized, magic-aliasing) ship inline."""
+        if any(
+            tx.startswith(EPOCH_MAGIC) for tx in block.transactions
+        ):
+            # Epoch control transactions (ISSUE 20) must ride the vertex
+            # itself: the boundary scan reads delivered blocks, and a
+            # lane carrier would hide the magic behind a payload ref
+            # that stragglers resolve at different times.
+            self._submit_inline(block)
+            return
         pending = self.lanes.begin_publish(block)
         if pending is None:
             self._submit_inline(block)
@@ -421,6 +464,9 @@ class Process:
         self.metrics.inc("msgs_received")
         if msg.kind != "val" or msg.vertex is None:
             self._on_control(msg)
+            return
+        if self.epoch_mgr is not None and msg.epoch < self.epoch_mgr.epoch:
+            self._epoch_reject_stale(msg)
             return
         if self._vector:
             # Defer the admission checks to step(): nothing between
@@ -509,6 +555,17 @@ class Process:
     def _on_control(self, msg: BroadcastMessage) -> None:
         """Non-VAL dispatch, shared by both pump paths (the caller has
         already counted msgs_received)."""
+        if (
+            self.epoch_mgr is not None
+            and msg.epoch < self.epoch_mgr.epoch
+            and (msg.kind == "cert" or msg.kind == "cert_span")
+        ):
+            # Signed pre-rotation consensus traffic replayed after the
+            # boundary: reject at the seam (ISSUE 20). sync/sync_nack
+            # stay exempt — a straggler's sync probe is how it learns it
+            # is behind and enters the state-transfer path.
+            self._epoch_reject_stale(msg)
+            return
         if msg.kind == "sync":
             self._serve_sync(msg)
         elif msg.kind == "sync_nack":
@@ -596,6 +653,9 @@ class Process:
         cert_pool = self._cert_pool
         cert_done = self._cert_done
         my_index = self.index
+        cur_epoch = (
+            self.epoch_mgr.epoch if self.epoch_mgr is not None else None
+        )
         last_r = -1  # round-group cache: batches arrive in same-round runs
         grp: Optional[Dict[int, Vertex]] = None
         seen_row: Optional[List[Optional[bytes]]] = None
@@ -603,6 +663,9 @@ class Process:
         pool_row: Optional[Dict[int, Vertex]] = None
         pool_this = False
         for msg in inbox:
+            if cur_epoch is not None and msg.epoch < cur_epoch:
+                self._epoch_reject_stale(msg)
+                continue
             v = msg.vertex
             ok = msg.__dict__.get("_stamp_ok")
             if ok is None or ok[0] != n:
@@ -1073,6 +1136,9 @@ class Process:
                 span
             ):
                 continue
+            # the aggregator banks its own span for snapshot attestation
+            # (ISSUE 20) — receivers bank verified spans in _apply_span
+            self._span_chain[e] = span
             self.metrics.inc("spans_assembled")
             self.log.event("span_assembled", first_round=first, rounds=k)
             self.transport.broadcast(
@@ -1082,6 +1148,7 @@ class Process:
                     sender=self.index,
                     kind="cert_span",
                     span=span,
+                    epoch=self._wire_epoch,
                 )
             )
 
@@ -1111,6 +1178,7 @@ class Process:
             return False
         self.metrics.inc("spans_verified")
         self._span_done.add(e)
+        self._span_chain[e] = span
         admitted = False
         for r in pending:
             covered = dict(
@@ -1172,6 +1240,7 @@ class Process:
                     sender=self.index,
                     kind="cert",
                     cert=cert,
+                    epoch=self._wire_epoch,
                 )
             )
 
@@ -1209,6 +1278,11 @@ class Process:
             if self._pipelined_waves:
                 progress |= self._try_waves_pipelined()
             progress |= self._retry_pending_waves()
+            if self.epoch_mgr is not None:
+                progress |= self._epoch_retry_held_waves()
+                live = int(self.dag.exists.sum())
+                if live > self.metrics.counters["vertices_live_max"]:
+                    self.metrics.counters["vertices_live_max"] = live
             made_progress |= progress
             if not progress and self._verify_owed:
                 # quiescent with masks still in the hold-tail window:
@@ -1527,6 +1601,18 @@ class Process:
                 if w not in self._waves_tried:
                     self._waves_tried.add(w)
                     self._try_wave(w)
+            if self.epoch_mgr is not None and self.epoch_mgr.hold_round(
+                r + 1, self.cfg.wave_length
+            ):
+                # Epoch barrier (ISSUE 20): rounds past the boundary's
+                # last round belong to the next epoch and must carry
+                # next-epoch coin shares — a mix of pre- and
+                # post-rotation shares for one wave can never aggregate,
+                # which would wedge the retro leader chain. Hold here
+                # until the boundary chunk delivers and the local epoch
+                # crosses; every correct process converges at round 4B.
+                self.metrics.inc("epoch_barrier_holds")
+                break
             if not self.blocks_to_propose and not self.cfg.propose_empty:
                 break  # paper: wait until a block is available
             self.round += 1
@@ -1567,7 +1653,12 @@ class Process:
         Byzantine strategies in consensus/adversary.py — cannot corrupt
         this process's own dense mirrors, only test its peers."""
         self.transport.broadcast(
-            BroadcastMessage(vertex=v, round=v.round, sender=self.index)
+            BroadcastMessage(
+                vertex=v,
+                round=v.round,
+                sender=self.index,
+                epoch=self._wire_epoch,
+            )
         )
 
     def _create_vertex(self, rnd: int) -> Vertex:
@@ -1786,6 +1877,7 @@ class Process:
             sender=self.index,
             kind="sync",
             origin=hi,
+            epoch=self._wire_epoch,
         )
         # Anti-entropy is PULL gossip: ask ONE peer per patience window,
         # rotating deterministically, instead of broadcasting the
@@ -1913,6 +2005,7 @@ class Process:
                     sender=self.index,
                     kind="sync_nack",
                     origin=msg.sender,
+                    epoch=self._wire_epoch,
                 )
             )
             return
@@ -1930,7 +2023,10 @@ class Process:
         for r in range(lo, hi + 1):
             for v in self.dag.vertices_in_round(r):
                 out = BroadcastMessage(
-                    vertex=v, round=v.round, sender=v.source
+                    vertex=v,
+                    round=v.round,
+                    sender=v.source,
+                    epoch=self._wire_epoch,
                 )
                 if send is not None:
                     try:
@@ -2236,7 +2332,7 @@ class Process:
             self.metrics.observe_wave_commit(partial + t.seconds)
         self.maybe_prune()
 
-    def maybe_prune(self) -> int:
+    def maybe_prune(self, floor: Optional[int] = None) -> int:
         """Retire DAG/process state below the GC horizon (cfg.gc_depth).
 
         The floor is ``oldest_undelivered_leader_round - gc_depth``: the
@@ -2245,14 +2341,28 @@ class Process:
         diverge the total order. Pending deferred delivery walks anchor
         the floor at their oldest leader — pruning may never outrun a
         delivery that is merely deferred. Returns vertices removed.
+
+        ``floor`` overrides the computed horizon (epoch-boundary GC,
+        ISSUE 20): the caller — :meth:`_epoch_advance` — passes a floor
+        that is a pure function of the committed boundary, so every
+        correct process prunes at the same point in the total order and
+        the ``base_round`` delivery exclusion stays identical
+        everywhere. Deferred delivery walks still clamp it.
         """
         gc = self.cfg.gc_depth
-        if gc is None or self.decided_wave == 0:
-            return 0
-        anchor = self.cfg.wave_round(self.decided_wave, 1)
-        for (_, _, oldest_round) in self._deferred_orders:
-            anchor = min(anchor, oldest_round)
-        floor = anchor - gc
+        if floor is None and self._epoch_gc_floor is not None:
+            # one-shot epoch-boundary floor armed by _epoch_advance
+            floor, self._epoch_gc_floor = self._epoch_gc_floor, None
+        if floor is None:
+            if gc is None or self.decided_wave == 0:
+                return 0
+            anchor = self.cfg.wave_round(self.decided_wave, 1)
+            for (_, _, oldest_round) in self._deferred_orders:
+                anchor = min(anchor, oldest_round)
+            floor = anchor - gc
+        else:
+            for (_, _, oldest_round) in self._deferred_orders:
+                floor = min(floor, oldest_round - (gc or 1))
         if floor <= self.dag.base_round:
             return 0
         old_base = self.dag.base_round
@@ -2328,6 +2438,14 @@ class Process:
                 }
                 self._span_done = {
                     e for e in self._span_done if (e + 1) * k > base
+                }
+                # the attestation chain keeps exactly the spans whose
+                # window overlaps the restorable DAG (rounds > base) —
+                # what snapshot_bytes will cover (ISSUE 20)
+                self._span_chain = {
+                    e: s
+                    for e, s in self._span_chain.items()
+                    if (e + 1) * k > base
                 }
         # A reliable-broadcast stage keeps per-slot vote books — retire
         # them along the same floor (transport/rbc.py prune_below), or a
@@ -2427,6 +2545,7 @@ class Process:
         gc = self.cfg.gc_depth
         while not leaders.is_empty():
             leader = leaders.pop()
+            chunk_start = len(self.delivered_log)
             # Delivered-pruned closure: identical fresh set as the full
             # closure (delivery is causally closed), but the sweep stops
             # at the already-delivered frontier instead of descending the
@@ -2448,6 +2567,7 @@ class Process:
             lo = lo_round - base
             hi = leader.round + 1 - base
             if hi <= lo:
+                self._epoch_note_delivery(leader, chunk_start)
                 continue
             fresh = reached[lo:hi] & ~dmask[lo:hi]
             if self._vector:
@@ -2495,6 +2615,7 @@ class Process:
                                 round=rr + lo_round,
                                 source=src,
                             )
+                self._epoch_note_delivery(leader, chunk_start)
                 continue
             for rr, src in np.argwhere(fresh):
                 vid = VertexID(int(rr) + lo_round, int(src))
@@ -2512,6 +2633,7 @@ class Process:
                         self.log.event(
                             "tx_deliver", round=vid.round, source=vid.source
                         )
+            self._epoch_note_delivery(leader, chunk_start)
         self.log.event(
             "delivered",
             count=len(self.delivered_log) - n_before,
@@ -2542,3 +2664,238 @@ class Process:
             self._eager_mask = self._delivered_mask.copy()
             self.eager_log = []
             self._eager_cursor = 0
+
+    # ------------------------------------------------------------------
+    # Epoch reconfiguration (ISSUE 20)
+    # ------------------------------------------------------------------
+
+    @property
+    def _wire_epoch(self) -> int:
+        """Epoch id stamped on outgoing messages. 0 (static membership
+        or epoch 0) makes the codec omit the epoch section entirely, so
+        pre-epoch deployments keep byte-identical wire frames."""
+        mgr = self.epoch_mgr
+        return mgr.epoch if mgr is not None else 0
+
+    def _epoch_reject_stale(self, msg: BroadcastMessage) -> None:
+        """Count + trace one rejected pre-rotation message (the caller
+        has already matched kind and compared epochs)."""
+        self.metrics.inc("epoch_stale_rejected")
+        self.log.event(
+            "epoch_stale",
+            kind=msg.kind,
+            msg_epoch=msg.epoch,
+            epoch=self.epoch_mgr.epoch,
+            sender=msg.sender,
+        )
+
+    def _epoch_note_delivery(
+        self, leader: Vertex, chunk_start: int
+    ) -> None:
+        """Entry seam for the epoch ladder (analysis/ladder.py): called
+        once per committed leader chunk from :meth:`_order_vertices`.
+        With reconfiguration off it falls through to the static-
+        membership oracle; with it on, the chunk is scanned for control
+        transactions and the boundary crossing is evaluated."""
+        if self.epoch_mgr is None:
+            self._epoch_static()
+            return
+        self._epoch_scan_chunk(leader, chunk_start)
+
+    def _epoch_static(self) -> None:
+        """Static-membership oracle: membership never changes, so a
+        delivered chunk carries no reconfiguration consequence. The
+        explicit seam (rather than an inlined no-op) is what lets the
+        ladder checker prove the degradation edge stays intact."""
+
+    def _epoch_scan_chunk(self, leader: Vertex, chunk_start: int) -> None:
+        """Scan the chunk just delivered for ``leader`` (delivery-log
+        entries from ``chunk_start`` on) for epoch control transactions,
+        then cross the boundary if this chunk's wave reached it. Both
+        halves are pure functions of the total order, so every correct
+        process schedules and crosses identically."""
+        mgr = self.epoch_mgr
+        wave = self.cfg.wave_of_round(leader.round)
+        had_boundary = mgr.boundary_wave
+        accepted = 0
+        vertices = self.dag.vertices
+        for vid in self.delivered_log[chunk_start:]:
+            v = vertices.get(vid)
+            if v is not None and v.block.transactions:
+                accepted += mgr.note_block(v.block, wave)
+        if accepted:
+            self.metrics.inc("epoch_ctrl_txs", accepted)
+            if had_boundary is None and mgr.boundary_wave is not None:
+                self.log.event(
+                    "epoch_scheduled",
+                    boundary=mgr.boundary_wave,
+                    wave=wave,
+                    ops=accepted,
+                )
+        if mgr.should_advance(wave):
+            self._epoch_advance()
+
+    def _epoch_advance(self) -> None:
+        """Cross the pending boundary: rotate the threshold-coin keys
+        (mode per cfg.epoch_rotate), retire the finished epoch's wave
+        books (coin share/sigma entries and wave one-shot memos at or
+        below the boundary — the planted-leak test pins this), and arm
+        the epoch GC floor so the settled prefix prunes into the
+        span-attested snapshot window."""
+        mgr = self.epoch_mgr
+        t = mgr.advance()
+        b = t.boundary_wave
+        self.metrics.inc("epoch_boundaries")
+        self.metrics.counters["epoch_current"] = mgr.epoch
+        mode = self.cfg.epoch_rotate
+        if mode != "none" and getattr(self.coin, "keys", None) is not None:
+            keys = derive_epoch_keys(
+                t, self.cfg.n, self.cfg.f + 1, mode, self.index
+            )
+            if keys is not None:
+                self.coin.rotate(keys, t.first_wave)
+                self.metrics.inc("epoch_rotations")
+        # Finished-epoch cleanup (satellite 3): waves <= B are settled
+        # (the crossing itself proves decided_wave >= B), so their share
+        # books and one-shot/memo entries are dead weight that the
+        # round-floor prune would otherwise keep alive until the GC
+        # window catches up.
+        self.coin.prune_below(t.first_wave)
+        self._pending_waves = {w for w in self._pending_waves if w > b}
+        self._waves_spent = {w for w in self._waves_spent if w > b}
+        self._waves_tried = {w for w in self._waves_tried if w > b}
+        self._wave_try_memo = {
+            w: f for w, f in self._wave_try_memo.items() if w > b
+        }
+        gc = self.cfg.gc_depth
+        if gc is not None:
+            # Epoch GC floor: keep epoch_gc rounds (default gc_depth)
+            # behind the boundary's last round, clamped so it never
+            # outruns the ordering rule's exclusion window for the next
+            # possible leader (round 4B+1 delivers down to 4B+2-gc).
+            # Applied at the NEXT maybe_prune — never mid-ordering,
+            # where _order_vertices holds dense-array aliases.
+            depth = self.cfg.epoch_gc or gc
+            wl = self.cfg.wave_length
+            floor = min(
+                self.cfg.wave_round(b, wl) - depth,
+                self.cfg.wave_round(b + 1, 1) - gc,
+            )
+            if self._epoch_gc_floor is None or floor > self._epoch_gc_floor:
+                self._epoch_gc_floor = floor
+        self.log.event(
+            "epoch_advanced",
+            epoch=mgr.epoch,
+            boundary=b,
+            ops=len(t.ops),
+            seed=t.seed.hex()[:16],
+        )
+
+    def _epoch_retry_held_waves(self) -> bool:
+        """While the barrier holds the round counter at the boundary's
+        last round, the scalar oracle's one-shot boundary attempt for
+        waves <= B has already been spent — but those waves keep filling
+        as straggler vertices land, and the crossing cannot happen until
+        one of them decides. Re-attempt them with the same fills-changed
+        memo the pipelined pass uses (which is why pipelined mode needs
+        no twin of this)."""
+        mgr = self.epoch_mgr
+        if (
+            mgr is None
+            or mgr.boundary_wave is None
+            or self._pipelined_waves
+        ):
+            return False
+        before = self.decided_wave
+        wl = self.cfg.wave_length
+        for w in range(self.decided_wave + 1, mgr.boundary_wave + 1):
+            if w <= self.decided_wave:
+                continue
+            fills = (
+                self.dag.round_size(self.cfg.wave_round(w, wl)),
+                self.dag.round_size(self.cfg.wave_round(w, 1)),
+            )
+            if fills[0] < self.cfg.quorum:
+                continue
+            if self._wave_try_memo.get(w) == fills:
+                continue
+            self._wave_try_memo[w] = fills
+            self._try_wave(w, quiet=True)
+        return self.decided_wave > before
+
+    # -- checkpoint seam ------------------------------------------------
+
+    def epoch_state(self) -> Optional[Dict]:
+        """JSON-serializable epoch manager state for checkpoint
+        manifests and snapshot heads (None = static membership)."""
+        mgr = self.epoch_mgr
+        if mgr is None:
+            return None
+        return {
+            "epoch": mgr.epoch,
+            "seed": mgr.seed.hex(),
+            "epoch_waves": mgr.epoch_waves,
+            "boundary_wave": mgr.boundary_wave,
+            "pending_ops": [
+                [wave, op.kind, op.target, op.nonce, op.payload.hex()]
+                for wave, op in mgr.pending_ops
+            ],
+            "last_boundary": (
+                mgr.history[-1].boundary_wave if mgr.history else 0
+            ),
+        }
+
+    def restore_epoch_state(self, d: Optional[Dict]) -> None:
+        """Install checkpointed epoch state (inverse of
+        :meth:`epoch_state`) and re-derive the restored epoch's coin
+        keys — both rotation modes chain every input from the committed
+        seed, so a joiner lands on the exact key set the survivors
+        rotated to at the original crossing."""
+        import hashlib as _hashlib
+
+        mgr = self.epoch_mgr
+        if mgr is None or not d:
+            return
+        mgr.epoch = int(d.get("epoch", 0))
+        seed_hex = d.get("seed")
+        if seed_hex:
+            mgr.seed = bytes.fromhex(seed_hex)
+        bw = d.get("boundary_wave")
+        mgr.boundary_wave = int(bw) if bw is not None else None
+        mgr.pending_ops = []
+        mgr._seen = set()
+        for wave, kind, target, nonce, payload in d.get(
+            "pending_ops", []
+        ):
+            op = EpochOp(
+                kind=kind,
+                target=int(target),
+                nonce=int(nonce),
+                payload=bytes.fromhex(payload),
+            )
+            mgr._seen.add(
+                _hashlib.sha256(encode_epoch_op(op)).digest()
+            )
+            mgr.pending_ops.append((int(wave), op))
+        last_b = int(d.get("last_boundary", 0))
+        if (
+            mgr.epoch > 0
+            and self.cfg.epoch_rotate != "none"
+            and getattr(self.coin, "keys", None) is not None
+        ):
+            t = EpochTransition(
+                epoch=mgr.epoch,
+                boundary_wave=last_b,
+                seed=mgr.seed,
+                ops=(),
+            )
+            keys = derive_epoch_keys(
+                t,
+                self.cfg.n,
+                self.cfg.f + 1,
+                self.cfg.epoch_rotate,
+                self.index,
+            )
+            if keys is not None:
+                self.coin.rotate(keys, t.first_wave)
+        self.metrics.counters["epoch_current"] = mgr.epoch

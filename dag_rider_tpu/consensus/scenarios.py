@@ -93,6 +93,14 @@ class Scenario:
     #: the stock 32-byte scenario blocks sit below the lane batch floor
     #: and ship inline, so the legacy matrix is byte-identical either way.
     lanes: Optional[bool] = None
+    #: epoch reconfiguration (ISSUE 20). None resolves to forced-on for
+    #: the stale_epoch adversary (its attack surface IS the wire stale
+    #: gate) and off everywhere else — epoch scenarios inject one
+    #: ``rotate`` control op at the start so a boundary genuinely
+    #: crosses mid-run. Coin stays round_robin here: the matrix's
+    #: shared-book threshold factory cannot rotate per-process keys.
+    epoch: Optional[bool] = None
+    epoch_waves: int = 4
 
     def __post_init__(self) -> None:
         if self.adversary is not None and self.adversary not in ADVERSARIES:
@@ -129,6 +137,11 @@ class Scenario:
         if self.lanes is not None:
             return self.lanes
         return self.adversary in ("lane_withhold", "lane_garbage_ack")
+
+    def resolved_epoch(self) -> bool:
+        if self.epoch is not None:
+            return self.epoch
+        return self.adversary == "stale_epoch"
 
     def resolved_rbc(self) -> bool:
         if self.rbc is not None:
@@ -220,6 +233,8 @@ def run_scenario(sc: Scenario) -> dict:
         # runs the whole legacy matrix with lanes on; 32-byte blocks
         # stay inline there by the batch-size floor)
         lanes=True if sc.resolved_lanes() else None,
+        epoch=True if sc.resolved_epoch() else False,
+        epoch_waves=sc.epoch_waves,
         # virtual-time lockstep: wall-clock flood control off
         sync_request_cooldown_s=0.0,
         sync_serve_cooldown_s=0.0,
@@ -266,6 +281,16 @@ def run_scenario(sc: Scenario) -> dict:
             tx = f"s{sc.seed}-p{i}-b{k}".encode().ljust(pad, b".")
             accepted.add(tx)
             sim.processes[i].submit(Block((tx,)))
+    if sc.resolved_epoch():
+        # one committed rotate op -> a deterministic boundary crosses
+        # mid-run; the op itself is an accepted tx, so zero-loss also
+        # proves control traffic survives the adversary
+        from dag_rider_tpu.core.codec import encode_epoch_op
+        from dag_rider_tpu.core.types import EpochOp
+
+        op = encode_epoch_op(EpochOp("rotate", 0, sc.seed, b""))
+        accepted.add(op)
+        sim.processes[honest[0]].submit(Block((op,)))
     if sc.resolved_lanes():
         # Byzantine lane workers only misbehave on their OWN publishes
         # (withhold their own batches / garble their acks), so feed them
@@ -392,6 +417,17 @@ def run_scenario(sc: Scenario) -> dict:
             for i in range(cfg.n)
         ),
         "lanes": bool(cfg.lanes),
+        "epoch": bool(cfg.epoch),
+        "epoch_boundaries": _counter("epoch_boundaries"),
+        "epoch_min": (
+            min(
+                sim.processes[i].metrics.counters.get("epoch_current", 0)
+                for i in honest
+            )
+            if cfg.epoch
+            else 0
+        ),
+        "epoch_stale_rejected": _counter("epoch_stale_rejected"),
         "lane_batches_certified": _counter("lane_batches_certified"),
         "lane_fetch_misses": _counter("lane_fetch_misses"),
         "lane_publish_degraded": _counter("lane_publish_degraded"),
@@ -427,6 +463,12 @@ def default_matrix(
         mk(adversary="lane_withhold"),
         mk(adversary="lane_garbage_ack"),
         mk(adversary="equivocate", wan="regions"),
+        mk(adversary="stale_epoch"),
+        # straggler-join: the honest tail is dark while the boundary
+        # commits; on heal it must sync across the epoch (the sync /
+        # sync_nack exemption from the stale gate is what lets a
+        # behind-the-epoch node discover it is behind at all)
+        mk(name="epoch_straggler", epoch=True, wan="partition"),
     ]
 
 
@@ -441,6 +483,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--adversary", choices=ADVERSARIES, default=None
     )
     ap.add_argument("--wan", choices=WAN_PROFILES, default="lan")
+    ap.add_argument(
+        "--epoch",
+        action="store_true",
+        help="force epoch reconfiguration on (a rotate op is injected)",
+    )
     ap.add_argument(
         "--matrix",
         action="store_true",
@@ -460,6 +507,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cycles=args.cycles,
                 adversary=args.adversary,
                 wan=args.wan,
+                epoch=True if args.epoch else None,
             )
         ]
     reports = []

@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence, Tuple
 from dag_rider_tpu.core.types import (
     Block,
     BroadcastMessage,
+    EpochOp,
     LaneRef,
     RoundCertificate,
     SpanCertificate,
@@ -201,11 +202,24 @@ _KINDS = (
 )
 
 
+#: high bit of the kind byte flags a trailing u32 epoch section (ISSUE
+#: 20). Epoch-0 messages — everything a static-membership deployment
+#: ever sends, and every byte already on the wire or in a WAL — keep
+#: their exact pre-epoch layout, same discipline as DRv2's conditional
+#: cert_sig blob.
+_EPOCH_BIT = 0x80
+
+
 def encode_message(msg: BroadcastMessage) -> bytes:
     """Message layout: round, sender, kind byte, origin (int32, -1 = none),
-    digest (int32 length prefix, -1 = none), vertex-present flag + vertex."""
+    digest (int32 length prefix, -1 = none), vertex-present flag + vertex.
+    When ``msg.epoch > 0`` the kind byte carries ``_EPOCH_BIT`` and a u32
+    epoch id trails the message."""
+    kind_byte = _KINDS.index(msg.kind)
+    if msg.epoch > 0:
+        kind_byte |= _EPOCH_BIT
     out = [
-        struct.pack("<IIB", msg.round, msg.sender, _KINDS.index(msg.kind)),
+        struct.pack("<IIB", msg.round, msg.sender, kind_byte),
         struct.pack("<i", -1 if msg.origin is None else msg.origin),
     ]
     if msg.digest is None:
@@ -233,12 +247,16 @@ def encode_message(msg: BroadcastMessage) -> bytes:
         else:
             out.append(b"\x01")
             out.append(encode_span_certificate(msg.span))
+    if msg.epoch > 0:
+        out.append(struct.pack("<I", msg.epoch))
     return b"".join(out)
 
 
 def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]:
     rnd, sender, kind_code = struct.unpack_from("<IIB", data, offset)
     offset += 9
+    has_epoch = bool(kind_code & _EPOCH_BIT)
+    kind_code &= ~_EPOCH_BIT
     (origin,) = struct.unpack_from("<i", data, offset)
     offset += 4
     (dlen,) = struct.unpack_from("<i", data, offset)
@@ -265,6 +283,10 @@ def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]
         offset += 1
         if has_span:
             span, offset = decode_span_certificate(data, offset)
+    epoch = 0
+    if has_epoch:
+        (epoch,) = struct.unpack_from("<I", data, offset)
+        offset += 4
     return (
         BroadcastMessage(
             vertex=v,
@@ -275,6 +297,7 @@ def decode_message(data: bytes, offset: int = 0) -> Tuple[BroadcastMessage, int]
             digest=digest,
             cert=cert,
             span=span,
+            epoch=epoch,
         ),
         offset,
     )
@@ -376,6 +399,64 @@ def lane_ref_of(block: Block) -> Optional[LaneRef]:
         return None
     try:
         return decode_lane_ref(block.transactions[0])
+    except (ValueError, struct.error):
+        return None
+
+
+# -- epoch reconfiguration control transactions (ISSUE 20) ------------------
+
+#: an epoch op is the magic-prefixed pseudo-transaction of an ordinary
+#: block; 8 bytes like LANE_MAGIC so no honest payload shorter than the
+#: prefix aliases, and distinct from it so the two control lanes never
+#: collide
+EPOCH_MAGIC = b"DRepoch\x00"
+
+_EPOCH_OPS = ("join", "leave", "rotate")
+
+
+def encode_epoch_op(op: EpochOp) -> bytes:
+    """Encode an :class:`EpochOp` as a control pseudo-transaction.
+
+    Layout after the magic: u8 op kind, u32 target index, u32 nonce,
+    u32 payload length + bytes."""
+    return b"".join(
+        (
+            EPOCH_MAGIC,
+            struct.pack("<BII", _EPOCH_OPS.index(op.kind), op.target,
+                        op.nonce),
+            struct.pack("<I", len(op.payload)),
+            op.payload,
+        )
+    )
+
+
+def decode_epoch_op(tx: bytes) -> Optional[EpochOp]:
+    """Parse a control pseudo-transaction; None when ``tx`` is an
+    ordinary client transaction (no magic); raises on a malformed
+    magic-prefixed body."""
+    if not tx.startswith(EPOCH_MAGIC):
+        return None
+    off = len(EPOCH_MAGIC)
+    kind_code, target, nonce = struct.unpack_from("<BII", tx, off)
+    off += 9
+    (plen,) = struct.unpack_from("<I", tx, off)
+    off += 4
+    payload = tx[off : off + plen]
+    if kind_code >= len(_EPOCH_OPS) or off + plen != len(tx):
+        raise ValueError("malformed epoch op")
+    return EpochOp(_EPOCH_OPS[kind_code], target, nonce, payload)
+
+
+def epoch_op_of(tx: bytes) -> Optional[EpochOp]:
+    """The op a control transaction carries, or None for a client
+    transaction. Same degradation rule as :func:`lane_ref_of`: a
+    MALFORMED magic-prefixed transaction (only a Byzantine or buggy
+    submitter can craft one) is treated as an ordinary payload — the
+    ordered log surfaces the garbage bytes as-is instead of crashing
+    the delivery walk, and every correct process ignores it for epoch
+    scheduling identically."""
+    try:
+        return decode_epoch_op(tx)
     except (ValueError, struct.error):
         return None
 

@@ -112,6 +112,26 @@ class LaneRef:
 
 
 @dataclasses.dataclass(frozen=True)
+class EpochOp:
+    """One reconfiguration request (ISSUE 20), ordered through consensus
+    as the magic-prefixed pseudo-transaction of an ordinary block (see
+    :func:`dag_rider_tpu.core.codec.encode_epoch_op`).
+
+    ``kind`` is "join" | "leave" | "rotate"; ``target`` the node index
+    joining or leaving (0 for a pure key rotation); ``nonce`` a
+    submitter-chosen tag so identical requests stay distinguishable in
+    the ordered log; ``payload`` carries opaque operator material (e.g.
+    a joiner's identity seed), folded into the epoch seed derivation so
+    rotated keys commit to it.
+    """
+
+    kind: str
+    target: int = 0
+    nonce: int = 0
+    payload: bytes = b""
+
+
+@dataclasses.dataclass(frozen=True)
 class Vertex:
     """A DAG vertex (reference ``process/process.go:26-31``).
 
@@ -299,3 +319,8 @@ class BroadcastMessage:
     cert: Optional[RoundCertificate] = None
     #: cert-of-certs, only for kind == "cert_span" (ISSUE 12)
     span: Optional[SpanCertificate] = None
+    #: reconfiguration epoch the sender was in (ISSUE 20). 0 is the
+    #: genesis epoch and the only value static-membership deployments
+    #: ever see; the codec emits the epoch wire section only when > 0,
+    #: so pre-epoch bytes decode unchanged.
+    epoch: int = 0

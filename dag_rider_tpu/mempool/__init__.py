@@ -31,6 +31,7 @@ import time
 from typing import Callable, Dict, Iterable, List, NamedTuple, Optional
 
 from dag_rider_tpu.config import MempoolConfig, env_float
+from dag_rider_tpu.core.codec import EPOCH_MAGIC
 from dag_rider_tpu.core.types import Block
 from dag_rider_tpu.mempool.admission import AdmissionController
 from dag_rider_tpu.mempool.batcher import BlockBatcher
@@ -97,6 +98,13 @@ class Mempool:
         #: carrying it is a_delivered (or the entry is TTL'd / evicted).
         #: Doubles as the dedup horizon for in-flight-but-batched txs.
         self._inflight: Dict[bytes, float] = {}
+        #: epoch control-op lane (ISSUE 20): EPOCH_MAGIC transactions
+        #: bypass the admission ladder (shedding a membership change
+        #: under load is the exact moment you need it) and ship in
+        #: their own block ahead of payload batches — never inside a
+        #: lane carrier, so the delivery-time boundary scan always
+        #: sees the magic inline.
+        self._control: List[bytes] = []
         #: in-flight bound: a wedged cluster must not grow this forever
         self._inflight_cap = 4 * self.cfg.cap
         from dag_rider_tpu.utils.metrics import Histogram
@@ -131,6 +139,15 @@ class Mempool:
                     # way re-admitting would deliver the payload twice
                     deduped += 1
                     self.pool.deduped += 1
+                    continue
+                if tx.startswith(EPOCH_MAGIC):
+                    accepted += 1
+                    self._control.append(tx)
+                    self._note_inflight(tx, t)
+                    if trace and sample_tx(tx, self.trace_sample):
+                        self.log.event(
+                            "tx_submit", tx=tx_key(tx), client=client
+                        )
                     continue
                 if not self.admission.decide(client, self.pool.fill, t):
                     shed += 1
@@ -195,12 +212,22 @@ class Mempool:
                 self._adapt_deadline()
             for tx in self.pool.expire(t):
                 self._inflight.pop(tx, None)
+            control: List[Block] = []
+            if self._control:
+                # control lane flush: one dedicated block, ahead of any
+                # payload batch and exempt from the staging bound — a
+                # reconfiguration op must reach its boundary even when
+                # the payload path is backlogged
+                control.append(Block(tuple(self._control)))
+                self._control = []
             limit: Optional[int] = None
             if not force:
                 limit = max(0, self.cfg.max_staged_blocks - staged)
                 if limit == 0:
-                    return []
-            blocks = self.batcher.drain(t, force=force, limit=limit)
+                    return control
+            blocks = control + self.batcher.drain(
+                t, force=force, limit=limit
+            )
             if blocks and self.log.enabled:
                 for b in blocks:
                     keys = [
@@ -306,6 +333,8 @@ class Mempool:
                 "pending": [
                     [e.client, e.tx.hex()] for e in self.pool.pending()
                 ],
+                # un-flushed control ops survive a restart too
+                "control": [tx.hex() for tx in self._control],
             }
 
     def restore_state(
@@ -323,4 +352,10 @@ class Mempool:
             for client, tx in entries:
                 if tx in self.pool:
                     self._note_inflight(tx, t)
+            for hx in state.get("control", []):
+                tx = bytes.fromhex(hx)
+                if tx not in self._inflight:
+                    self._control.append(tx)
+                    self._note_inflight(tx, t)
+                    restored += 1
             return restored

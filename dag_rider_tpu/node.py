@@ -203,6 +203,11 @@ class Node:
             pump=cfg.get("pump"),
             # aggregated round certificates; None defers to DAGRIDER_CERT
             cert=cfg.get("cert"),
+            # certificate patience is counted in quiescent pump ticks
+            # (~ms each): the in-process default of 6 is far too tight
+            # for share aggregation over real sockets, so the cluster
+            # harness overrides it per node
+            cert_patience=int(cfg.get("cert_patience", 6)),
         )
         with open(cfg["keys"]) as fh:
             keyblob = json.load(fh)
@@ -263,8 +268,13 @@ class Node:
             auth=auth,
             # Peer state transfer (elastic recovery past the GC horizon):
             # serve our live DAG window; it is self-certifying, see
-            # utils.checkpoint.restore_from_snapshot.
-            snapshot_provider=lambda: checkpoint.snapshot_bytes(self.process),
+            # utils.checkpoint.restore_from_snapshot. Attested (ISSUE
+            # 20): the envelope carries our verified span chain so a
+            # joiner settles the window with ~1 pairing per span; falls
+            # back to the plain blob when no spans are banked.
+            snapshot_provider=lambda: checkpoint.attested_snapshot_bytes(
+                self.process
+            ),
             # Donor-side availability knobs: per-relayer serve interval,
             # and the request-timestamp freshness window (fleets with
             # known clock skew widen it; null in the JSON config
@@ -726,7 +736,10 @@ class Node:
             peer, timeout_s=self.snapshot_timeout_s
         )
         if blob and checkpoint.restore_from_snapshot(
-            self.process, blob, verifier=self.process.verifier
+            self.process,
+            blob,
+            verifier=self.process.verifier,
+            span_verifier=getattr(self.process, "cert_verifier", None),
         ):
             self.log.event(
                 "state_transferred",

@@ -166,6 +166,10 @@ def save(process, path: str, *, mempool=None) -> None:
             for e, bank in process._span_bank.items()
         },
         "metrics": process.metrics.snapshot(),
+        # Epoch reconfiguration cursor (ISSUE 20): epoch id, chained
+        # seed, pending boundary + op batch. None/absent (pre-epoch
+        # manifests) restores as static membership / epoch 0.
+        "epoch": process.epoch_state(),
     }
     # Lane state (ISSUE 17): certified batch bytes + sequence cursor. A
     # crash between certification and delivery must not lose the payload
@@ -264,7 +268,18 @@ def restore(process, path: str, *, mempool=None) -> None:
         delivered_claim = [
             (int(r), int(s)) for r, s in manifest["delivered_log"]
         ]
-    except (KeyError, TypeError, ValueError) as exc:
+        # epoch cursor (ISSUE 20): dry-parse before any mutation so a
+        # torn epoch section fails the whole restore atomically
+        epoch_claim = manifest.get("epoch")
+        if epoch_claim is not None:
+            int(epoch_claim.get("epoch", 0))
+            bytes.fromhex(epoch_claim.get("seed") or "")
+            for wave, kind, target, nonce, payload in epoch_claim.get(
+                "pending_ops", []
+            ):
+                int(wave), str(kind), int(target), int(nonce)
+                bytes.fromhex(payload)
+    except (KeyError, TypeError, ValueError, AttributeError) as exc:
         raise CorruptCheckpointError(
             f"checkpoint manifest cursors invalid: {exc}"
         ) from exc
@@ -373,6 +388,10 @@ def restore(process, path: str, *, mempool=None) -> None:
         if os.path.exists(mp_path):
             with open(mp_path) as fh:
                 mempool.restore_state(json.load(fh))
+    # Epoch cursor last: restoring it rotates the coin schedule, which
+    # must see the already-restored decided/wave state. Pre-epoch
+    # manifests carry no key and leave the manager at epoch 0.
+    process.restore_epoch_state(manifest.get("epoch"))
 
 
 # ---------------------------------------------------------------------------
@@ -409,14 +428,21 @@ def snapshot_bytes(process) -> bytes:
         top = process.dag.max_round
         if process.dag.base_round != base:
             continue  # pruned mid-copy: the window moved, retry
-        head = json.dumps(
-            {
-                "version": 1,
-                "n": process.cfg.n,
-                "base_round": base,
-                "max_round": top,
-            }
-        ).encode()
+        head_obj = {
+            "version": 1,
+            "n": process.cfg.n,
+            "base_round": base,
+            "max_round": top,
+        }
+        # Epoch cursor (ISSUE 20): the joiner must land on the donor's
+        # epoch + chained seed or its rotated coin keys diverge from the
+        # survivors'. Omitted entirely pre-epoch, so epoch-less
+        # snapshots stay byte-identical to the previous format.
+        _es = getattr(process, "epoch_state", None)
+        epoch_state = _es() if _es is not None else None
+        if epoch_state is not None:
+            head_obj["epoch"] = epoch_state
+        head = json.dumps(head_obj).encode()
         out = [struct.pack("<I", len(head)), head]
         for v in vertices:
             if v.round < base:
@@ -428,7 +454,88 @@ def snapshot_bytes(process) -> bytes:
     return b""  # persistently racing prunes: refuse this request
 
 
-def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
+# -- span-attested snapshot envelope (ISSUE 20) -----------------------------
+#
+# Layout: magic, u32 span count, then each span certificate u32-length-
+# prefixed in the canonical cert codec, then the plain snapshot blob.
+# A plain (un-enveloped) blob is still accepted everywhere — attestation
+# is an overlay, exactly like the span path it reuses: the receiver pays
+# ONE combined pairing check per span and may then admit every vertex
+# whose digest the verified span restates WITHOUT a per-vertex signature
+# check (the quorum already BLS-co-signed those digests), instead of
+# replaying the window vertex by vertex.
+
+SNAP_ATTEST_MAGIC = b"DRsnapA1"
+
+
+def wrap_attested(blob: bytes, spans) -> bytes:
+    """Envelope ``blob`` with its attesting span-certificate chain."""
+    out = [SNAP_ATTEST_MAGIC, struct.pack("<I", len(spans))]
+    for s in spans:
+        enc = codec.encode_span_certificate(s)
+        out.append(struct.pack("<I", len(enc)))
+        out.append(enc)
+    out.append(blob)
+    return b"".join(out)
+
+
+def unwrap_attested(data: bytes):
+    """Split an attested envelope into (spans, inner blob).
+
+    Plain blobs pass through as ``(None, data)``. A magic-prefixed blob
+    that does not parse cleanly — truncated span section, trailing
+    garbage inside a span, short header — raises ValueError: a torn
+    envelope must refuse wholesale, never degrade to "unattested"."""
+    if not data.startswith(SNAP_ATTEST_MAGIC):
+        return None, data
+    off = len(SNAP_ATTEST_MAGIC)
+    if off + 4 > len(data):
+        raise ValueError("attested snapshot: truncated span count")
+    (count,) = struct.unpack_from("<I", data, off)
+    off += 4
+    spans = []
+    for _ in range(count):
+        if off + 4 > len(data):
+            raise ValueError("attested snapshot: truncated span section")
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if off + ln > len(data):
+            raise ValueError("attested snapshot: span overruns envelope")
+        span, used = codec.decode_span_certificate(data[off : off + ln])
+        if used != ln:
+            raise ValueError("attested snapshot: span section malformed")
+        spans.append(span)
+        off += ln
+    return spans, data[off:]
+
+
+def attested_snapshot_bytes(process) -> bytes:
+    """:func:`snapshot_bytes` enveloped with the donor's verified span
+    chain (``Process._span_chain``) for the covered window. Falls back
+    to the plain blob when the donor holds no spans (span path off, or
+    a window younger than the first assembled span)."""
+    blob = snapshot_bytes(process)
+    if not blob:
+        return b""
+    chain = getattr(process, "_span_chain", None)
+    if not chain:
+        return blob
+    base = process.dag.base_round
+    spans = [
+        chain[e] for e in sorted(chain) if chain[e].last_round > base
+    ]
+    if not spans:
+        return blob
+    process.metrics.inc("snapshot_spans_attached", len(spans))
+    process.log.event(
+        "snapshot_attested", spans=len(spans), base=base
+    )
+    return wrap_attested(blob, spans)
+
+
+def restore_from_snapshot(
+    process, blob: bytes, verifier=None, span_verifier=None
+) -> bool:
     """Rebuild a process (fresh OR live-but-stuck — the node runtime
     calls this on its started process from the pump thread) from an
     untrusted peer snapshot. ATOMIC: the window is validated and staged
@@ -447,10 +554,28 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     ``verifier``: the Verifier seam used to batch-check every round>=1
     vertex signature; None skips signature checks (signature-less
     deployments only — matching the reference's no-crypto mode).
+
+    ``span_verifier`` (ISSUE 20): a CertVerifier used to check an
+    attested envelope's span chain — one combined pairing per span.
+    Vertices whose digests a verified span restates are admitted
+    without a per-vertex signature check (the quorum already BLS-
+    co-signed those digests); a digest mismatch against a verified span
+    means the donor tampered with the window and refuses it wholesale.
+    With ``span_verifier=None`` the envelope's spans are ignored and
+    every vertex pays the ordinary signature check — attestation only
+    ever removes work, never trust.
     """
     from dag_rider_tpu.consensus.dag_state import DagState
     from dag_rider_tpu.core.types import Vertex as _V
 
+    try:
+        spans, blob = unwrap_attested(blob)
+    except (ValueError, struct.error):
+        # torn or tampered envelope: refused wholesale, never degraded
+        # to "unattested"
+        process.metrics.inc("snapshot_attest_rejects")
+        process.log.event("snapshot_attest_reject", reason="envelope")
+        return False
     try:
         (hlen,) = struct.unpack_from("<I", blob, 0)
         head = json.loads(blob[4 : 4 + hlen])
@@ -471,7 +596,17 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
     try:
         base = int(head.get("base_round", 0))
         head_max = int(head.get("max_round", 1 << 62))
-    except (TypeError, ValueError):
+        # dry-parse the optional epoch section (ISSUE 20) BEFORE any
+        # commit below — a malformed section refuses wholesale, it must
+        # never leave the DAG imported under the wrong coin keys
+        _ep = head.get("epoch")
+        if _ep is not None:
+            int(_ep.get("epoch", 0))
+            bytes.fromhex(_ep.get("seed") or "")
+            for _w, _k, _t, _nc, _pl in _ep.get("pending_ops", []):
+                int(_w), str(_k), int(_t), int(_nc)
+                bytes.fromhex(_pl)
+    except (TypeError, ValueError, AttributeError, KeyError):
         return False
     if base < 0:
         return False
@@ -489,10 +624,55 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
         # emitted (duplicate a_deliver). Only windows strictly above our
         # progress are state-transfer material.
         return False
+    # Span attestation (ISSUE 20): verify the chain — ONE combined
+    # pairing per span — then fold every attested (round, source) ->
+    # digest claim into a lookup the admission pass below consults.
+    span_good = {}
+    if spans is not None and span_verifier is not None:
+        for s in spans:
+            process.metrics.inc("snapshot_pairing_checks")
+            if not span_verifier.verify_span(s):
+                process.metrics.inc("snapshot_attest_rejects")
+                process.log.event(
+                    "snapshot_attest_reject",
+                    reason="span",
+                    first_round=s.first_round,
+                )
+                return False
+            process.metrics.inc("snapshot_spans_verified")
+            for i in range(len(s.signers)):
+                r = s.first_round + i
+                for src, dg in zip(s.signers[i], s.digests[i]):
+                    span_good[(r, src)] = dg
     signed = [v for v in vertices if v.round >= 1]
+    if span_good:
+        # Attested digests substitute for per-vertex signature checks:
+        # the span's quorum BLS-co-signed exactly these (round, source,
+        # digest) claims, so a byte-identical vertex needs no second
+        # proof of authorship — this is what makes a joiner's sync cost
+        # ~1 pairing per settled span instead of a vertex-by-vertex
+        # replay. A MISMATCHED digest is donor tampering: refuse.
+        need, pre = [], set()
+        for v in signed:
+            want = span_good.get((v.round, v.source))
+            if want is None:
+                need.append(v)
+            elif want == (v.__dict__.get("_digest") or v.digest()):
+                pre.add(v.id)
+            else:
+                process.metrics.inc("snapshot_attest_rejects")
+                process.log.event(
+                    "snapshot_attest_reject",
+                    reason="digest",
+                    round=v.round,
+                    source=v.source,
+                )
+                return False
+    else:
+        need, pre = signed, set()
     if verifier is not None:
-        ok = verifier.verify_batch(signed)
-        good = {v.id for v, m in zip(signed, ok) if m}
+        ok = verifier.verify_batch(need)
+        good = pre | {v.id for v, m in zip(need, ok) if m}
     else:
         good = {v.id for v in signed}
     usable = [
@@ -584,6 +764,22 @@ def restore_from_snapshot(process, blob: bytes, verifier=None) -> bool:
         # a live laggard's pre-transfer share books are below the new
         # floor too (same class as the RBC books two lines up)
         process.coin.prune_below(process.cfg.wave_of_round(base))
+    # Epoch cursor (ISSUE 20): land on the donor's epoch + seed so this
+    # node's rotated coin keys match the survivors' — the snapshot head
+    # is covered by the same trust argument as the window itself (a
+    # lying epoch/seed diverges the coin and fails liveness locally,
+    # never corrupts peers). Pre-epoch heads carry no key -> epoch 0.
+    _res = getattr(process, "restore_epoch_state", None)
+    if _res is not None:
+        try:
+            _res(head.get("epoch"))
+        except (KeyError, TypeError, ValueError, AttributeError):
+            # malformed epoch section from an untrusted donor: the DAG
+            # import above is still sound (it never depended on epoch
+            # state), so keep it and stay at the local epoch cursor
+            process.log.event(
+                "snapshot_attest_reject", reason="epoch_head"
+            )
     inserted = len(accepted)
     process.metrics.inc("state_transfers")
     process.log.event(

@@ -111,6 +111,20 @@ KNOWN_COUNTERS = frozenset(
         "net_client_submits",
         "checkpoint_corrupt",
         "cluster_reinjects",
+        # epoch reconfiguration (ISSUE 20)
+        "epoch_path_enabled",
+        "epoch_current",
+        "epoch_ctrl_txs",
+        "epoch_boundaries",
+        "epoch_rotations",
+        "epoch_barrier_holds",
+        "epoch_stale_rejected",
+        "vertices_live_max",
+        # span-attested snapshot sync (ISSUE 20)
+        "snapshot_spans_attached",
+        "snapshot_spans_verified",
+        "snapshot_attest_rejects",
+        "snapshot_pairing_checks",
     }
 )
 

@@ -110,6 +110,12 @@ KNOWN_EVENTS = frozenset(
         # flight-recorder triggers + bookkeeping
         "invariant_violation",
         "flight_dump",
+        # epoch reconfiguration (ISSUE 20)
+        "epoch_scheduled",
+        "epoch_advanced",
+        "epoch_stale",
+        "snapshot_attested",
+        "snapshot_attest_reject",
     }
 )
 
